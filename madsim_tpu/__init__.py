@@ -1,0 +1,58 @@
+"""madsim_tpu — a TPU-native deterministic simulation testing (DST) framework.
+
+Re-designed from scratch with the capability surface of madsim-rs/madsim
+(deterministic async runtime + virtual time + seeded chaos + simulated
+network/RPC/infra), but architected TPU-first:
+
+* **Host engine** (`madsim_tpu.runtime`, `.task`, `.time`, `.net`, ...):
+  a single-threaded, seed-deterministic async runtime that is the API
+  surface, debugger, and replayer — the equivalent of the reference's
+  ``madsim`` crate compiled with ``--cfg madsim``
+  (reference: madsim/src/sim/runtime/mod.rs, sim/task/mod.rs).
+
+* **TPU engine** (`madsim_tpu.engine`): the same discrete-event semantics
+  expressed as a JAX ``lax.while_loop`` over struct-of-arrays state,
+  ``vmap``-ed over seeds and sharded over a ``jax.sharding.Mesh`` so
+  thousands of independent seeds + fault schedules advance in lockstep on
+  TPU HBM. Failing seeds replay bit-identically on the host (counter-based
+  Philox RNG + integer-nanosecond virtual time shared by both engines).
+
+One seed => one bit-identical execution, on either engine.
+"""
+
+from . import buggify, config, rand, time, task, plugin, runtime, sync, net, fs, signal
+from .runtime import Runtime, Handle, NodeBuilder, NodeHandle
+from .task import spawn
+from .errors import (
+    SimError,
+    Deadlock,
+    JoinError,
+    TimeLimitExceeded,
+    NonDeterminism,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Runtime",
+    "Handle",
+    "NodeBuilder",
+    "NodeHandle",
+    "spawn",
+    "buggify",
+    "config",
+    "rand",
+    "time",
+    "task",
+    "plugin",
+    "runtime",
+    "sync",
+    "net",
+    "fs",
+    "signal",
+    "SimError",
+    "Deadlock",
+    "JoinError",
+    "TimeLimitExceeded",
+    "NonDeterminism",
+]

@@ -1,0 +1,163 @@
+"""Simulated filesystem (reference: madsim/src/sim/fs.rs).
+
+Per-node in-memory inode map with positional read/write, metadata and
+read-only enforcement. `power_fail` (dropping unsynced buffered writes)
+is a documented stub in the reference (fs.rs:50-53,:205-207); here it
+clears nothing yet either, but the hook exists and is called on node
+reset so chaos scenarios can opt in later.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from . import _context
+from .errors import SimError
+from .plugin import Simulator
+
+
+class FsError(SimError):
+    pass
+
+
+class INode:
+    """Reference: fs.rs:125 `INode`."""
+
+    __slots__ = ("data", "readonly")
+
+    def __init__(self) -> None:
+        self.data = bytearray()
+        self.readonly = False
+
+
+class FsSim(Simulator):
+    """Reference: fs.rs:24 `FsSim`."""
+
+    def __init__(self, rng, time, config):
+        super().__init__(rng, time, config)
+        self._nodes: Dict[int, Dict[str, INode]] = {}
+
+    def create_node(self, node_id: int) -> None:
+        self._nodes.setdefault(node_id, {})
+
+    def reset_node(self, node_id: int) -> None:
+        """Node kill/restart: trigger power-fail semantics
+        (reference: fs.rs:38-40 — TODO in the reference as well)."""
+        self.power_fail(node_id)
+
+    def power_fail(self, node_id: int) -> None:
+        """Stub (reference: fs.rs:50-53): buffered-write loss not yet
+        simulated; files persist across restarts like synced data."""
+
+    def fs_of(self, node_id: int) -> Dict[str, INode]:
+        return self._nodes.setdefault(node_id, {})
+
+
+def _current_fs() -> Dict[str, INode]:
+    from .plugin import simulator
+    from .task import current_node_id
+
+    return simulator(FsSim).fs_of(current_node_id())
+
+
+class Metadata:
+    def __init__(self, size: int, readonly: bool):
+        self._size = size
+        self._readonly = readonly
+
+    def len(self) -> int:
+        return self._size
+
+    def is_readonly(self) -> bool:
+        return self._readonly
+
+
+class File:
+    """Positional-I/O file handle (reference: fs.rs:68 `FsNodeHandle`/File)."""
+
+    def __init__(self, inode: INode, writable: bool):
+        self._inode = inode
+        self._writable = writable
+
+    @staticmethod
+    async def open(path: str) -> "File":
+        fs = _current_fs()
+        if path not in fs:
+            raise FsError(f"file not found: {path}")
+        return File(fs[path], writable=not fs[path].readonly)
+
+    @staticmethod
+    async def create(path: str) -> "File":
+        fs = _current_fs()
+        inode = fs.get(path)
+        if inode is None:
+            inode = INode()
+            fs[path] = inode
+        if inode.readonly:
+            raise FsError(f"file is read-only: {path}")
+        inode.data = bytearray()
+        return File(inode, writable=True)
+
+    async def read_at(self, buf_len: int, offset: int) -> bytes:
+        data = self._inode.data
+        return bytes(data[offset : offset + buf_len])
+
+    async def read_all(self) -> bytes:
+        return bytes(self._inode.data)
+
+    async def write_all_at(self, data: bytes, offset: int) -> None:
+        if not self._writable or self._inode.readonly:
+            raise FsError("file is read-only")
+        buf = self._inode.data
+        end = offset + len(data)
+        if len(buf) < end:
+            buf.extend(b"\x00" * (end - len(buf)))
+        buf[offset:end] = data
+
+    async def set_len(self, size: int) -> None:
+        if not self._writable or self._inode.readonly:
+            raise FsError("file is read-only")
+        buf = self._inode.data
+        if len(buf) > size:
+            del buf[size:]
+        else:
+            buf.extend(b"\x00" * (size - len(buf)))
+
+    async def sync_all(self) -> None:
+        pass
+
+    async def metadata(self) -> Metadata:
+        return Metadata(len(self._inode.data), self._inode.readonly)
+
+
+async def read(path: str) -> bytes:
+    f = await File.open(path)
+    return await f.read_all()
+
+
+async def write(path: str, data: bytes) -> None:
+    f = await File.create(path)
+    await f.write_all_at(data, 0)
+
+
+async def remove_file(path: str) -> None:
+    fs = _current_fs()
+    if path not in fs:
+        raise FsError(f"file not found: {path}")
+    del fs[path]
+
+
+async def metadata(path: str) -> Metadata:
+    fs = _current_fs()
+    if path not in fs:
+        raise FsError(f"file not found: {path}")
+    inode = fs[path]
+    return Metadata(len(inode.data), inode.readonly)
+
+
+def set_readonly(path: str, readonly: bool = True) -> None:
+    """Test helper mirroring the reference's read-only enforcement."""
+    fs = _current_fs()
+    if path not in fs:
+        raise FsError(f"file not found: {path}")
+    fs[path].readonly = readonly

@@ -1,0 +1,8 @@
+"""Simulated network (reference: madsim/src/sim/net/).
+
+Phase B of the build plan (SURVEY.md §7) fills this package with the
+Network fabric, NetSim simulator, Endpoint, TCP/UDP, DNS/IPVS and the
+typed RPC layer.
+"""
+
+__all__ = []

@@ -1,0 +1,136 @@
+"""Env-driven multi-seed test harness (reference: madsim/src/sim/runtime/builder.rs).
+
+Reads the same `MADSIM_TEST_*` environment variables as the reference
+(:64-120) so existing madsim workflows translate directly:
+
+  MADSIM_TEST_SEED                first seed (default 1... here: 1)
+  MADSIM_TEST_NUM                 number of seeds to run (default 1)
+  MADSIM_TEST_JOBS                seeds run concurrently (default 1)
+  MADSIM_TEST_CONFIG              path to a TOML Config file
+  MADSIM_TEST_TIME_LIMIT          virtual-seconds limit per run
+  MADSIM_TEST_CHECK_DETERMINISM   run every seed twice + compare RNG logs
+
+On failure it prints the reproduction hint, like the reference's
+"MADSIM_TEST_SEED={seed}" message (sim/runtime/mod.rs:205-210).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import functools
+import os
+import sys
+import threading
+from typing import Any, Callable, Coroutine, List, Optional
+
+from ..config import Config
+from . import Runtime
+
+
+class Builder:
+    """Reference: sim/runtime/builder.rs:7-22 `Builder`."""
+
+    def __init__(
+        self,
+        seed: int = 1,
+        count: int = 1,
+        jobs: int = 1,
+        config: Optional[Config] = None,
+        time_limit: Optional[float] = None,
+        check: bool = False,
+    ):
+        self.seed = seed
+        self.count = count
+        self.jobs = jobs
+        self.config = config
+        self.time_limit = time_limit
+        self.check = check
+
+    @staticmethod
+    def from_env() -> "Builder":
+        """Reference: builder.rs:64-120 `from_env`."""
+        config = None
+        config_path = os.environ.get("MADSIM_TEST_CONFIG")
+        if config_path:
+            with open(config_path, "r", encoding="utf-8") as f:
+                config = Config.from_toml(f.read())
+        time_limit_s = os.environ.get("MADSIM_TEST_TIME_LIMIT")
+        return Builder(
+            seed=int(os.environ.get("MADSIM_TEST_SEED", "1")),
+            count=int(os.environ.get("MADSIM_TEST_NUM", "1")),
+            jobs=int(os.environ.get("MADSIM_TEST_JOBS", "1")),
+            config=config,
+            time_limit=float(time_limit_s) if time_limit_s else None,
+            check=os.environ.get("MADSIM_TEST_CHECK_DETERMINISM", "") not in ("", "0", "false"),
+        )
+
+    def _run_one(self, seed: int, factory: Callable[[], Coroutine]) -> Any:
+        if self.check:
+            return Runtime.check_determinism(
+                seed, factory, self.config, time_limit=self.time_limit
+            )
+        rt = Runtime(seed, self.config)
+        if self.time_limit is not None:
+            rt.set_time_limit(self.time_limit)
+        return rt.block_on(factory())
+
+    def run(self, factory: Callable[[], Coroutine]) -> Any:
+        """Run `count` seeds, `jobs` at a time, each runtime on its own
+        thread (reference: builder.rs:121-160). Returns the last result."""
+        seeds = list(range(self.seed, self.seed + self.count))
+        result: Any = None
+        if self.jobs <= 1:
+            for seed in seeds:
+                result = self._run_in_thread(seed, factory)
+            return result
+        with concurrent.futures.ThreadPoolExecutor(max_workers=self.jobs) as pool:
+            futs = {pool.submit(self._run_one, seed, factory): seed for seed in seeds}
+            for fut in concurrent.futures.as_completed(futs):
+                seed = futs[fut]
+                try:
+                    result = fut.result()
+                except BaseException:
+                    print(
+                        f"note: run with `MADSIM_TEST_SEED={seed}` environment "
+                        f"variable to reproduce this failure",
+                        file=sys.stderr,
+                    )
+                    raise
+        return result
+
+    def _run_in_thread(self, seed: int, factory: Callable[[], Coroutine]) -> Any:
+        """One runtime per fresh thread, like the reference harness."""
+        box: List[Any] = [None, None]
+
+        def target() -> None:
+            try:
+                box[0] = self._run_one(seed, factory)
+            except BaseException as exc:  # noqa: BLE001
+                box[1] = exc
+
+        t = threading.Thread(target=target, name=f"madsim-seed-{seed}")
+        t.start()
+        t.join()
+        if box[1] is not None:
+            print(
+                f"note: run with `MADSIM_TEST_SEED={seed}` environment "
+                f"variable to reproduce this failure",
+                file=sys.stderr,
+            )
+            raise box[1]
+        return box[0]
+
+
+def main(fn: Callable[..., Coroutine]) -> Callable[..., Any]:
+    """`#[madsim::main]` equivalent (reference: madsim-macros/src/lib.rs:115-152):
+    decorate an async fn so calling it runs `Builder.from_env().run`."""
+
+    @functools.wraps(fn)
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        return Builder.from_env().run(lambda: fn(*args, **kwargs))
+
+    return wrapper
+
+
+# `#[madsim::test]` equivalent — usable directly under pytest.
+test = main

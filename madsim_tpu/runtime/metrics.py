@@ -1,0 +1,39 @@
+"""Runtime metrics (reference: madsim/src/sim/runtime/metrics.rs)."""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict
+
+if TYPE_CHECKING:
+    from ..task.executor import Executor
+
+
+class RuntimeMetrics:
+    """Live task census (reference: metrics.rs:6-40)."""
+
+    def __init__(self, executor: "Executor"):
+        self._executor = executor
+
+    def num_nodes(self) -> int:
+        return len(self._executor.nodes)
+
+    def num_tasks(self) -> int:
+        return sum(len(n.tasks) for n in self._executor.nodes.values())
+
+    def num_tasks_by_node(self) -> Dict[str, int]:
+        return {
+            n.name: len(n.tasks)
+            for n in self._executor.nodes.values()
+            if n.tasks
+        }
+
+    def num_tasks_by_node_by_spawn(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for n in self._executor.nodes.values():
+            if not n.tasks:
+                continue
+            per: Dict[str, int] = {}
+            for t in n.tasks:
+                per[t.location] = per.get(t.location, 0) + 1
+            out[n.name] = per
+        return out

@@ -1,0 +1,83 @@
+"""Task spawning API (reference: madsim/src/sim/task/mod.rs public surface).
+
+`spawn` puts a coroutine on the *current node* — the simulated process
+whose task is running right now — exactly like the reference's
+`task::spawn` spawning onto the current `NodeInfo`.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Coroutine, Optional
+
+from .. import _context
+from ..future import yield_now
+from .executor import Executor, NodeInfo, TaskEntry, MAIN_NODE_ID
+from .join import AbortHandle, JoinHandle
+
+__all__ = [
+    "spawn",
+    "spawn_blocking",
+    "yield_now",
+    "JoinHandle",
+    "AbortHandle",
+    "Builder",
+    "NodeId",
+    "current_node_id",
+]
+
+NodeId = int
+
+
+def _caller_location(depth: int = 2) -> str:
+    frame = sys._getframe(depth)
+    return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+
+
+def spawn(coro: Coroutine, *, name: str = "") -> JoinHandle:
+    """Spawn a task onto the current node (reference: task::spawn)."""
+    ctx = _context.current()
+    node = ctx.current_task.node if ctx.current_task is not None else ctx.executor.main_node
+    task = ctx.executor.spawn(coro, node, location=_caller_location(), name=name)
+    return JoinHandle(task)
+
+
+def spawn_blocking(fn, *args: Any) -> JoinHandle:
+    """Run a sync function "blocking-style".
+
+    In simulation everything is one thread, so this just runs `fn` inside
+    a task (reference: spawn_blocking is spawn in sim mode).
+    """
+
+    async def runner():
+        return fn(*args)
+
+    ctx = _context.current()
+    node = ctx.current_task.node if ctx.current_task is not None else ctx.executor.main_node
+    task = ctx.executor.spawn(runner(), node, location=_caller_location(), name="blocking")
+    return JoinHandle(task)
+
+
+def current_node_id() -> NodeId:
+    """ID of the node the current task runs on."""
+    ctx = _context.current()
+    if ctx.current_task is not None:
+        return ctx.current_task.node.id
+    return ctx.executor.main_node.id
+
+
+class Builder:
+    """Named-task builder (reference: sim/task/builder.rs)."""
+
+    def __init__(self) -> None:
+        self._name = ""
+
+    def name(self, name: str) -> "Builder":
+        self._name = name
+        return self
+
+    def spawn(self, coro: Coroutine) -> JoinHandle:
+        ctx = _context.current()
+        node = ctx.current_task.node if ctx.current_task is not None else ctx.executor.main_node
+        task = ctx.executor.spawn(coro, node, location=_caller_location(), name=self._name)
+        return JoinHandle(task)

@@ -1,0 +1,225 @@
+"""Deterministic sync primitive tests (tokio-sync surface parity)."""
+
+import pytest
+
+from madsim_tpu import time as sim_time
+from madsim_tpu.errors import RecvError, SendError, TryRecvError
+from madsim_tpu.runtime import Runtime
+from madsim_tpu.sync import (
+    Barrier,
+    Mutex,
+    Notify,
+    RwLock,
+    Semaphore,
+    broadcast_channel,
+    mpsc_channel,
+    mpsc_unbounded_channel,
+    oneshot_channel,
+    watch_channel,
+)
+from madsim_tpu.task import spawn
+
+
+def run(coro_factory, seed=1):
+    return Runtime(seed=seed).block_on(coro_factory())
+
+
+def test_oneshot():
+    async def main():
+        tx, rx = oneshot_channel()
+
+        async def sender():
+            await sim_time.sleep(1.0)
+            tx.send("hello")
+
+        spawn(sender())
+        return await rx
+
+    assert run(main) == "hello"
+
+
+def test_oneshot_closed():
+    async def main():
+        tx, rx = oneshot_channel()
+        tx.close()
+        with pytest.raises(RecvError):
+            await rx
+        return True
+
+    assert run(main)
+
+
+def test_mpsc_bounded_backpressure():
+    async def main():
+        tx, rx = mpsc_channel(2)
+        sent = []
+
+        async def producer():
+            for i in range(5):
+                await tx.send(i)
+                sent.append(i)
+
+        spawn(producer())
+        await sim_time.sleep(1.0)
+        assert len(sent) == 2  # blocked at capacity
+        got = [await rx.recv() for _ in range(5)]
+        return got
+
+    assert run(main) == [0, 1, 2, 3, 4]
+
+
+def test_mpsc_close_raises():
+    async def main():
+        tx, rx = mpsc_unbounded_channel()
+        tx.try_send(1)
+        tx.close()  # last sender gone
+        assert await rx.recv() == 1
+        with pytest.raises(RecvError):
+            await rx.recv()
+        with pytest.raises(TryRecvError):
+            rx.try_recv()
+        return True
+
+    assert run(main)
+
+
+def test_watch():
+    async def main():
+        tx, rx = watch_channel(0)
+        seen = []
+
+        async def watcher():
+            while rx.borrow() < 3:
+                await rx.changed()
+                seen.append(rx.borrow_and_update())
+
+        h = spawn(watcher())
+
+        async def setter():
+            for i in range(1, 4):
+                await sim_time.sleep(1.0)
+                tx.send(i)
+
+        spawn(setter())
+        await h
+        return seen
+
+    assert run(main) == [1, 2, 3]
+
+
+def test_mutex_mutual_exclusion():
+    async def main():
+        m = Mutex(0)
+        trace = []
+
+        async def worker(tag):
+            guard = await m.lock()
+            with guard:
+                trace.append((tag, "in"))
+                await sim_time.sleep(1.0)
+                trace.append((tag, "out"))
+
+        hs = [spawn(worker(i)) for i in range(3)]
+        for h in hs:
+            await h
+        return trace
+
+    trace = run(main)
+    # critical sections never interleave
+    for i in range(0, len(trace), 2):
+        assert trace[i][0] == trace[i + 1][0]
+        assert trace[i][1] == "in" and trace[i + 1][1] == "out"
+
+
+def test_rwlock():
+    async def main():
+        lock = RwLock(0)
+        r1 = await lock.read()
+        r2 = await lock.read()  # concurrent readers OK
+        with r1, r2:
+            pass
+        w = await lock.write()
+        with w:
+            lock.value = 5
+        return lock.value
+
+    assert run(main) == 5
+
+
+def test_semaphore():
+    async def main():
+        sem = Semaphore(2)
+        active = {"n": 0, "max": 0}
+
+        async def worker():
+            async with _permit(sem):
+                active["n"] += 1
+                active["max"] = max(active["max"], active["n"])
+                await sim_time.sleep(1.0)
+                active["n"] -= 1
+
+        class _permit:
+            def __init__(self, sem):
+                self.sem = sem
+
+            async def __aenter__(self):
+                self.p = await self.sem.acquire()
+
+            async def __aexit__(self, *exc):
+                self.p.release()
+
+        hs = [spawn(worker()) for _ in range(6)]
+        for h in hs:
+            await h
+        return active["max"]
+
+    assert run(main) == 2
+
+
+def test_notify():
+    async def main():
+        n = Notify()
+        log = []
+
+        async def waiter():
+            await n.notified()
+            log.append("woke")
+
+        spawn(waiter())
+        await sim_time.sleep(1.0)
+        n.notify_one()
+        await sim_time.sleep(1.0)
+        return log
+
+    assert run(main) == ["woke"]
+
+
+def test_barrier():
+    async def main():
+        b = Barrier(3)
+        leaders = []
+
+        async def worker(i):
+            await sim_time.sleep(i * 1.0)
+            is_leader = await b.wait()
+            leaders.append(is_leader)
+
+        hs = [spawn(worker(i)) for i in range(3)]
+        for h in hs:
+            await h
+        return leaders
+
+    leaders = run(main)
+    assert sum(leaders) == 1
+    assert len(leaders) == 3
+
+
+def test_broadcast():
+    async def main():
+        tx, rx1 = broadcast_channel(16)
+        rx2 = tx.subscribe()
+        tx.send("a")
+        tx.send("b")
+        return [await rx1.recv(), await rx1.recv(), await rx2.recv(), await rx2.recv()]
+
+    assert run(main) == ["a", "b", "a", "b"]

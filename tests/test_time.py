@@ -1,0 +1,127 @@
+"""Virtual time tests (mirrors reference sim/time/mod.rs:257-305 and
+sim/time/system_time.rs:120-155)."""
+
+import pytest
+
+from madsim_tpu import time as sim_time
+from madsim_tpu.errors import Deadlock
+from madsim_tpu.runtime import Runtime
+from madsim_tpu.task import spawn
+
+
+def run(coro_factory, seed=1):
+    return Runtime(seed=seed).block_on(coro_factory())
+
+
+def test_sleep_advances_virtual_time_instantly():
+    async def main():
+        t0 = sim_time.now()
+        await sim_time.sleep(100.0)  # 100 virtual seconds
+        return sim_time.now() - t0
+
+    elapsed = run(main)
+    assert 100.0 <= elapsed < 100.1
+
+
+def test_sleep_ordering():
+    async def main():
+        order = []
+
+        async def sleeper(d, tag):
+            await sim_time.sleep(d)
+            order.append(tag)
+
+        h1 = spawn(sleeper(3.0, "c"))
+        h2 = spawn(sleeper(1.0, "a"))
+        h3 = spawn(sleeper(2.0, "b"))
+        await h1
+        await h2
+        await h3
+        return order
+
+    assert run(main) == ["a", "b", "c"]
+
+
+def test_timeout_expires():
+    async def main():
+        async def forever():
+            await sim_time.sleep(1e9)
+
+        try:
+            await sim_time.timeout(2.0, forever())
+        except TimeoutError:
+            return sim_time.now()
+        raise AssertionError("should have timed out")
+
+    t = run(main)
+    assert 2.0 <= t < 2.1
+
+
+def test_timeout_succeeds():
+    async def main():
+        async def quick():
+            await sim_time.sleep(0.5)
+            return 99
+
+        return await sim_time.timeout(2.0, quick())
+
+    assert run(main) == 99
+
+
+def test_interval_burst_and_skip():
+    async def main():
+        ticks = []
+        it = sim_time.interval(1.0)
+        for _ in range(3):
+            await it.tick()
+            ticks.append(sim_time.now())
+        return ticks
+
+    ticks = run(main)
+    # first tick immediate, then ~1s apart
+    assert ticks[0] < 0.01
+    assert 0.99 < ticks[1] - ticks[0] < 1.02
+    assert 0.99 < ticks[2] - ticks[1] < 1.02
+
+
+def test_advance_manual_jump():
+    async def main():
+        t0 = sim_time.now()
+        sim_time.advance(3600.0)
+        return sim_time.now() - t0
+
+    assert run(main) >= 3600.0
+
+
+def test_instant_and_system_time():
+    async def main():
+        i0 = sim_time.Instant.now()
+        s0 = sim_time.SystemTime.now()
+        await sim_time.sleep(5.0)
+        return i0.elapsed(), sim_time.SystemTime.now().duration_since(s0), s0
+
+    elapsed, sys_elapsed, s0 = run(main)
+    assert 5.0 <= elapsed < 5.1
+    assert 5.0 <= sys_elapsed < 5.1
+    # Base wall time is ~2022 + random offset (reference: sim/time/mod.rs:26-31).
+    assert s0.ns_since_epoch() > 1_640_000_000 * 10**9
+
+
+def test_system_time_three_distinct_across_seeds():
+    # (reference: sim/time/system_time.rs:122-137)
+    async def main():
+        return sim_time.SystemTime.now().ns_since_epoch()
+
+    outcomes = {Runtime(seed=i // 3).block_on(main()) for i in range(9)}
+    assert len(outcomes) == 3
+
+
+def test_deadlock_detection():
+    async def main():
+        from madsim_tpu.sync import oneshot_channel
+
+        _tx, rx = oneshot_channel()
+        await rx  # nobody ever sends
+
+    with pytest.raises(Deadlock):
+        run(main)
