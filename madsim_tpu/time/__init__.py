@@ -229,6 +229,13 @@ async def sleep(duration: Union[int, float]) -> None:
     await await_(SleepFuture(th.now_ns() + to_ns(duration)))
 
 
+async def sleep_ns(duration_ns: int) -> None:
+    """Sleep for an integer-nanosecond duration (the framework-internal
+    form; chaos latencies are always drawn in ns)."""
+    th = _context.current_time()
+    await await_(SleepFuture(th.now_ns() + duration_ns))
+
+
 async def sleep_until(deadline: Instant) -> None:
     await await_(SleepFuture(deadline._ns))
 

@@ -1,0 +1,462 @@
+"""Network fabric tests (mirrors reference sim/net/endpoint.rs:355-585,
+sim/net/tcp/mod.rs:58-308, sim/net/network.rs semantics)."""
+
+import pytest
+
+from madsim_tpu import time as sim_time
+from madsim_tpu.net import (
+    ConnectionRefused,
+    Direction,
+    Endpoint,
+    NetSim,
+    ServiceAddr,
+    TcpListener,
+    TcpStream,
+    UdpSocket,
+    lookup_host,
+)
+from madsim_tpu.plugin import simulator
+from madsim_tpu.runtime import Handle, Runtime
+from madsim_tpu.task import spawn
+
+
+def run(factory, seed=1):
+    return Runtime(seed=seed).block_on(factory())
+
+
+def two_nodes(handle):
+    a = handle.create_node().name("a").ip("10.1.0.1").build()
+    b = handle.create_node().name("b").ip("10.1.0.2").build()
+    return a, b
+
+
+def test_endpoint_send_recv():
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:500")
+            data, frm = await ep.recv_from(7)
+            await ep.send_to(frm, 8, data + b" world")
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ep.send_to("10.1.0.1:500", 7, b"hello")
+            data, _ = await ep.recv_from(8)
+            return data
+
+        b_h = a.spawn(server())
+        c_h = b.spawn(client())
+        result = await c_h
+        await b_h
+        return result
+
+    assert run(main) == b"hello world"
+
+
+def test_tag_matching_out_of_order():
+    # unmatched messages buffer; receivers match by tag regardless of order
+    # (reference: endpoint.rs mailbox tests)
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:500")
+            # receive tag 2 first even though tag 1 arrives first
+            d2, _ = await ep.recv_from(2)
+            d1, _ = await ep.recv_from(1)
+            return d1, d2
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ep.send_to("10.1.0.1:500", 1, b"one")
+            await sim_time.sleep(0.1)
+            await ep.send_to("10.1.0.1:500", 2, b"two")
+
+        s = a.spawn(server())
+        b.spawn(client())
+        return await s
+
+    assert run(main) == (b"one", b"two")
+
+
+def test_localhost_loopback():
+    async def main():
+        ep1 = await Endpoint.bind("127.0.0.1:600")
+        ep2 = await Endpoint.bind("0.0.0.0:0")
+        await ep2.send_to("127.0.0.1:600", 5, b"local")
+        data, _ = await ep1.recv_from(5)
+        return data
+
+    assert run(main) == b"local"
+
+
+def test_clog_node_blocks_datagrams():
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+        net = simulator(NetSim)
+        got = []
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:500")
+            while True:
+                data, _ = await ep.recv_from(1)
+                got.append(data)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ep.send_to("10.1.0.1:500", 1, b"m1")
+            await sim_time.sleep(1.0)
+            net.clog_node(a.id)
+            await ep.send_to("10.1.0.1:500", 1, b"m2")  # dropped
+            await sim_time.sleep(1.0)
+            net.unclog_node(a.id)
+            await ep.send_to("10.1.0.1:500", 1, b"m3")
+
+        a.spawn(server())
+        c = b.spawn(client())
+        await c
+        await sim_time.sleep(2.0)
+        return got
+
+    assert run(main) == [b"m1", b"m3"]
+
+
+def test_clog_link_directional():
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+        net = simulator(NetSim)
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:500")
+            while True:
+                data, frm = await ep.recv_from(1)
+                await ep.send_to(frm, 2, b"ack:" + data)
+
+        a.spawn(server())
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            # b -> a clogged: request lost
+            net.clog_link(b.id, a.id)
+            await ep.send_to("10.1.0.1:500", 1, b"lost")
+            try:
+                await sim_time.timeout(2.0, ep.recv_from(2))
+                return "unexpected"
+            except TimeoutError:
+                pass
+            net.unclog_link(b.id, a.id)
+            await ep.send_to("10.1.0.1:500", 1, b"ok")
+            data, _ = await ep.recv_from(2)
+            return data
+
+        return await b.spawn(client())
+
+    assert run(main) == b"ack:ok"
+
+
+def test_packet_loss_config():
+    from madsim_tpu.config import Config
+
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+        received = []
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:500")
+            while True:
+                data, _ = await ep.recv_from(1)
+                received.append(data)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            for i in range(100):
+                await ep.send_to("10.1.0.1:500", 1, bytes([i]))
+        a.spawn(server())
+        c = b.spawn(client())
+        await c
+        await sim_time.sleep(5.0)
+        return len(received)
+
+    cfg = Config()
+    cfg.net.packet_loss_rate = 0.5
+    n = Runtime(seed=3, config=cfg).block_on(main())
+    assert 20 < n < 80  # ~50% loss
+
+
+def test_kill_node_closes_sockets_and_port_released():
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:500")
+            await ep.recv_from(1)
+
+        a.spawn(server())
+        await sim_time.sleep(0.5)
+        handle.kill(a.id)
+        await sim_time.sleep(0.5)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ep.send_to("10.1.0.1:500", 1, b"x")  # silently dropped (no listener)
+            return True
+
+        return await b.spawn(client())
+
+    assert run(main)
+
+
+def test_udp_socket():
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+
+        async def server():
+            sock = await UdpSocket.bind("0.0.0.0:900")
+            data, frm = await sock.recv_from()
+            await sock.send_to(b"pong:" + data, frm)
+
+        async def client():
+            sock = await UdpSocket.bind("0.0.0.0:0")
+            await sock.send_to(b"ping", "10.1.0.1:900")
+            return await sock.recv()
+
+        a.spawn(server())
+        return await b.spawn(client())
+
+    assert run(main) == b"pong:ping"
+
+
+def test_tcp_roundtrip_and_eof():
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+
+        async def server():
+            lis = await TcpListener.bind("0.0.0.0:700")
+            stream, peer = await lis.accept()
+            while True:
+                data = await stream.read()
+                if not data:
+                    return "eof"
+                await stream.write_all(b"echo:" + data)
+
+        async def client():
+            stream = await TcpStream.connect("10.1.0.1:700")
+            await stream.write_all(b"abc")
+            r1 = await stream.read_exact(8)
+            await stream.write_all(b"def")
+            r2 = await stream.read_exact(8)
+            stream.shutdown()
+            return r1, r2
+
+        s = a.spawn(server())
+        c = b.spawn(client())
+        r1, r2 = await c
+        assert await s == "eof"
+        return r1, r2
+
+    assert run(main) == (b"echo:abc", b"echo:def")
+
+
+def test_tcp_connect_refused_when_partitioned():
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+        net = simulator(NetSim)
+
+        async def server():
+            lis = await TcpListener.bind("0.0.0.0:700")
+            await lis.accept()
+
+        a.spawn(server())
+        await sim_time.sleep(0.5)
+        net.partition([a.id], [b.id])
+
+        async def client():
+            try:
+                await TcpStream.connect("10.1.0.1:700")
+                return "connected"
+            except ConnectionRefused:
+                return "refused"
+
+        return await b.spawn(client())
+
+    assert run(main) == "refused"
+
+
+def test_tcp_clog_unclog_recovery():
+    # messages stall during a partition and flow after healing
+    # (reference: tcp/mod.rs clog/unclog test)
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+        net = simulator(NetSim)
+
+        async def server():
+            lis = await TcpListener.bind("0.0.0.0:700")
+            stream, _ = await lis.accept()
+            data = await stream.read_exact(4)
+            await stream.write_all(b"ack!")
+
+        a.spawn(server())
+
+        async def client():
+            stream = await TcpStream.connect("10.1.0.1:700")
+            net.partition([a.id], [b.id])
+            await stream.write_all(b"data")  # buffered/in-flight while clogged
+            spawn(healer())
+            t0 = sim_time.now()
+            ack = await stream.read_exact(4)
+            return ack, sim_time.now() - t0
+
+        async def healer():
+            await sim_time.sleep(5.0)
+            net.heal([a.id], [b.id])
+
+        ack, waited = await b.spawn(client())
+        assert ack == b"ack!"
+        assert waited >= 4.9  # stalled until heal
+        return True
+
+    assert run(main)
+
+
+def test_dns_and_lookup():
+    async def main():
+        handle = Handle.current()
+        a, _b = two_nodes(handle)
+        net = simulator(NetSim)
+        net.add_dns_record("server.local", "10.1.0.1")
+        ips = await lookup_host("server.local")
+        ips_port = await lookup_host("server.local:80")
+        with pytest.raises(OSError):
+            await lookup_host("missing.example")
+        return ips, ips_port
+
+    ips, ips_port = run(main)
+    assert ips == ["10.1.0.1"]
+    assert ips_port == ["10.1.0.1:80"]
+
+
+def test_ipvs_round_robin():
+    # (reference: tcp/mod.rs IPVS round-robin test + ipvs.rs)
+    async def main():
+        handle = Handle.current()
+        net = simulator(NetSim)
+        servers = []
+        for i in range(3):
+            node = handle.create_node().name(f"s{i}").ip(f"10.2.0.{i+1}").build()
+
+            async def serve(i=i):
+                lis = await TcpListener.bind("0.0.0.0:80")
+                while True:
+                    stream, _ = await lis.accept()
+                    await stream.write_all(f"server-{i}".encode())
+
+            node.spawn(serve(i))
+            servers.append(node)
+        client = handle.create_node().name("c").ip("10.2.0.99").build()
+
+        svc = ServiceAddr.tcp("10.9.9.9:80")
+        net.global_ipvs().add_service(svc)
+        for i in range(3):
+            net.global_ipvs().add_server(svc, f"10.2.0.{i+1}:80")
+
+        async def run_client():
+            got = []
+            for _ in range(6):
+                stream = await TcpStream.connect("10.9.9.9:80")
+                got.append((await stream.read_exact(8)).decode())
+            return got
+
+        return await client.spawn(run_client())
+
+    got = run(main)
+    assert got == ["server-0", "server-1", "server-2"] * 2
+
+
+def test_stat_msg_count():
+    async def main():
+        net = simulator(NetSim)
+        ep1 = await Endpoint.bind("127.0.0.1:600")
+        ep2 = await Endpoint.bind("0.0.0.0:0")
+        before = net.stat().msg_count
+        for _ in range(5):
+            await ep2.send_to("127.0.0.1:600", 5, b"x")
+        for _ in range(5):
+            await ep1.recv_from(5)
+        return net.stat().msg_count - before
+
+    assert run(main) == 5
+
+
+def test_dns_name_in_connect_and_send():
+    # DNS names resolve on every send/connect path (review regression)
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+        net = simulator(NetSim)
+        net.add_dns_record("svc.local", "10.1.0.1")
+
+        async def server():
+            lis = await TcpListener.bind("0.0.0.0:80")
+            stream, _ = await lis.accept()
+            await stream.write_all(b"via-dns")
+            ep = await Endpoint.bind("0.0.0.0:81")
+            data, _ = await ep.recv_from(3)
+            return data
+
+        s = a.spawn(server())
+
+        async def client():
+            stream = await TcpStream.connect("svc.local:80")
+            got = await stream.read_exact(7)
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ep.send_to("svc.local:81", 3, b"dgram")
+            return got
+
+        got = await b.spawn(client())
+        assert await s == b"dgram"
+        return got
+
+    assert run(main) == b"via-dns"
+
+
+def test_peer_kill_breaks_both_directions():
+    # killing the server breaks the client's write path too (review regression)
+    from madsim_tpu.net import ConnectionReset
+
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+
+        async def server():
+            lis = await TcpListener.bind("0.0.0.0:700")
+            await lis.accept()
+            await sim_time.sleep(1e9)
+
+        a.spawn(server())
+
+        async def client():
+            stream = await TcpStream.connect("10.1.0.1:700")
+            await stream.write_all(b"x")
+            await sim_time.sleep(1.0)
+            handle.kill(a.id)
+            await sim_time.sleep(1.0)
+            try:
+                await stream.write_all(b"y")
+                return "write-succeeded"
+            except ConnectionReset:
+                return "write-reset"
+
+        return await b.spawn(client())
+
+    assert run(main) == "write-reset"
