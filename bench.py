@@ -1,0 +1,67 @@
+"""Flagship benchmark: MadRaft 5-node log replication + partition injection.
+
+Measures seeds/sec on the TPU engine (the BASELINE.json north-star
+metric: >= 10,000 MadRaft 5-node simulations/sec on a v5e-8; this
+machine has ONE chip, so vs_baseline compares against the per-chip share
+of the target, 10_000/8 = 1250 seeds/sec/chip).
+
+Each "simulation" = one seed run to completion: boot 5 nodes, elect,
+replicate an 8-entry log under 2 random partition/kill faults, verify
+election + log-matching invariants on every event, horizon 5 virtual
+seconds (a lane typically processes ~200-400 events).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
+    from madsim_tpu.models.raft import RaftMachine
+
+    lanes = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    cfg = EngineConfig(
+        horizon_us=5_000_000,
+        queue_capacity=96,
+        faults=FaultPlan(n_faults=2, t_max_us=3_000_000, dur_min_us=200_000, dur_max_us=800_000),
+    )
+    eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
+    run = eng.make_runner(max_steps=3000)
+
+    # warmup / compile
+    res = run(jnp.arange(lanes, dtype=jnp.uint32))
+    jax.block_until_ready(res.done)
+
+    # timed runs on fresh seed batches (no caching of results possible)
+    reps = 3
+    t0 = time.perf_counter()
+    total = 0
+    for r in range(reps):
+        seeds = jnp.arange(1_000_000 * (r + 1), 1_000_000 * (r + 1) + lanes, dtype=jnp.uint32)
+        res = run(seeds)
+        jax.block_until_ready(res.done)
+        total += int(res.done.sum())
+    elapsed = time.perf_counter() - t0
+
+    seeds_per_sec = total / elapsed
+    per_chip_target = 10_000 / 8  # north star is for a v5e-8; we have 1 chip
+    print(
+        json.dumps(
+            {
+                "metric": "madraft5_seeds_per_sec_per_chip",
+                "value": round(seeds_per_sec, 1),
+                "unit": "seeds/sec",
+                "vs_baseline": round(seeds_per_sec / per_chip_target, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

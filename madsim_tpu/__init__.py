@@ -20,7 +20,7 @@ network/RPC/infra), but architected TPU-first:
 One seed => one bit-identical execution, on either engine.
 """
 
-from . import buggify, config, rand, time, task, plugin, runtime, sync, net, fs, signal
+from . import buggify, config, rand, time, task, plugin, runtime, sync, net, fs, signal, grpc, services
 from .runtime import Runtime, Handle, NodeBuilder, NodeHandle
 from .task import spawn
 from .errors import (

@@ -1,0 +1,58 @@
+"""The TPU engine: batched discrete-event simulation on device.
+
+See `core.py` for the architecture. Public surface:
+
+  * `Machine` — protocol step-function authoring base (machine.py)
+  * `Engine(machine, EngineConfig)` — batch runner: `make_runner()`,
+    `run_batch(seeds)`, `failing_seeds(result)`
+  * `replay(engine, seed)` — bit-identical single-seed CPU replay
+  * `FaultPlan` — randomized partition / kill-restart schedules
+"""
+
+from .core import (
+    BatchResult,
+    Engine,
+    EngineConfig,
+    FaultPlan,
+    LaneState,
+    EV_FAULT,
+    EV_MSG,
+    EV_TIMER,
+    OVERFLOW,
+)
+from .machine import (
+    BOOT,
+    Machine,
+    Outbox,
+    empty_outbox,
+    send,
+    send_if,
+    set_timer,
+    set_timer_if,
+    update_node,
+)
+from .replay import ReplayResult, TraceEvent, replay
+
+__all__ = [
+    "BatchResult",
+    "Engine",
+    "EngineConfig",
+    "FaultPlan",
+    "LaneState",
+    "Machine",
+    "Outbox",
+    "BOOT",
+    "empty_outbox",
+    "send",
+    "send_if",
+    "set_timer",
+    "set_timer_if",
+    "update_node",
+    "replay",
+    "ReplayResult",
+    "TraceEvent",
+    "EV_TIMER",
+    "EV_MSG",
+    "EV_FAULT",
+    "OVERFLOW",
+]

@@ -1,0 +1,450 @@
+"""The TPU engine — the discrete-event loop as a batched JAX computation.
+
+This is the tpu-native re-design of the reference's hot loop
+(`Executor::block_on` + timer queue + NetSim delivery,
+madsim/src/sim/task/mod.rs:220-323, sim/time/mod.rs:45-59,
+sim/net/mod.rs:298-334): one `lax.while_loop` advances a struct-of-arrays
+state where the leading dimension is the *seed lane*. Thousands of
+independent seeds + fault schedules run in lockstep on one chip; lanes
+shard over a `jax.sharding.Mesh` for multi-chip scale-out
+(seed-batch scaling, SURVEY.md §2.9).
+
+Design rules that make host replay bit-identical (SURVEY.md §7):
+  * integer virtual time (int32 microseconds), no float latency math
+  * counter-based RNG (jax threefry via jax.random — bit-deterministic
+    across CPU/TPU and eager/jit), one key per lane
+  * fixed-shape everything: event slots, outbox slots, node arrays;
+    overflow = lane failure (code OVERFLOW), never dynamic allocation
+
+Chaos parity with the host fabric: uniform integer latency in
+[min,max), Bernoulli loss, directional link clogging, node kill/restart
+with re-init (reference: sim/net/network.rs:261-270 + supervisor ops
+sim/runtime/mod.rs:272-301), driven by a per-lane `FaultPlan` drawn from
+the lane seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from ..ops import find_free_slot, pop_earliest
+from ..utils import set2d, tree_where
+from .machine import BOOT, Machine, Outbox
+
+# Event kinds
+EV_TIMER = 0
+EV_MSG = 1
+EV_FAULT = 2
+
+# Fault ops (payload[0])
+F_CLOG_PAIR = 0
+F_UNCLOG_PAIR = 1
+F_KILL = 2
+F_RESTART = 3
+
+# Failure codes
+OK = 0
+OVERFLOW = 1  # event queue full — lane aborts (host fallback)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Per-lane randomized fault schedule (drawn from the lane seed).
+
+    Each fault picks a random kind, start time and duration:
+      * partition: clog a random node pair both ways, heal after duration
+      * kill: kill a random node, restart after duration
+    """
+
+    n_faults: int = 0
+    allow_partition: bool = True
+    allow_kill: bool = True
+    t_min_us: int = 0
+    t_max_us: int = 1_000_000
+    dur_min_us: int = 100_000
+    dur_max_us: int = 1_000_000
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static engine parameters (python-level; baked into the jit)."""
+
+    horizon_us: int = 10_000_000  # 10 virtual seconds
+    queue_capacity: int = 64
+    latency_min_us: int = 1_000  # matches host NetConfig default 1-10ms
+    latency_max_us: int = 10_000
+    packet_loss_rate: float = 0.0
+    handler_rand_words: int = 4
+    faults: FaultPlan = dataclasses.field(default_factory=FaultPlan)
+
+
+@struct.dataclass
+class LaneState:
+    now_us: jax.Array
+    next_seq: jax.Array
+    step: jax.Array
+    rng_key: jax.Array  # uint32[2]
+    done: jax.Array
+    failed: jax.Array
+    fail_code: jax.Array
+    horizon_hit: jax.Array
+    msg_count: jax.Array
+    eq_time: jax.Array  # int32[Q]
+    eq_seq: jax.Array  # int32[Q]
+    eq_kind: jax.Array  # int32[Q]
+    eq_node: jax.Array  # int32[Q]
+    eq_src: jax.Array  # int32[Q]
+    eq_payload: jax.Array  # int32[Q, P]
+    eq_valid: jax.Array  # bool[Q]
+    clogged: jax.Array  # bool[N, N]
+    killed: jax.Array  # bool[N]
+    nodes: Any
+
+
+@struct.dataclass
+class BatchResult:
+    seeds: jax.Array
+    done: jax.Array
+    failed: jax.Array
+    fail_code: jax.Array
+    now_us: jax.Array
+    steps: jax.Array
+    msg_count: jax.Array
+    summary: Any
+
+
+class Engine:
+    """Bind a Machine + EngineConfig into jittable batch/replay runners."""
+
+    def __init__(self, machine: Machine, config: EngineConfig = EngineConfig()):
+        self.machine = machine
+        self.config = config
+        n, q = machine.NUM_NODES, config.queue_capacity
+        min_slots = n + 2 * config.faults.n_faults
+        if q < min_slots + machine.MAX_MSGS + machine.MAX_TIMERS:
+            raise ValueError(
+                f"queue_capacity={q} too small for {n} nodes + "
+                f"{config.faults.n_faults} faults + outbox headroom"
+            )
+
+    # -- lane init -----------------------------------------------------------
+
+    def init_lane(self, seed) -> LaneState:
+        m, cfg = self.machine, self.config
+        n, q, p = m.NUM_NODES, cfg.queue_capacity, m.PAYLOAD_WIDTH
+        key = jax.random.PRNGKey(seed)
+        key, k_init, k_faults = jax.random.split(key, 3)
+        nodes = m.init(k_init)
+
+        # BOOT timers for every node at t=0 in slots [0, n) (analogue of
+        # node init closures); all arrays built by static masks, no scatters.
+        slots = jnp.arange(q, dtype=jnp.int32)
+        is_boot_slot = slots < n
+        eq_time = jnp.zeros((q,), jnp.int32)
+        eq_seq = jnp.where(is_boot_slot, slots, 0)
+        eq_kind = jnp.zeros((q,), jnp.int32)  # EV_TIMER == 0
+        eq_node = jnp.where(is_boot_slot, slots, 0)
+        eq_src = jnp.full((q,), -1, jnp.int32)
+        eq_payload = jnp.zeros((q, p), jnp.int32)  # timer id BOOT == 0
+        eq_valid = is_boot_slot
+        next_seq = n
+
+        # Fault schedule: apply + undo event per fault, slots [n, n+2F).
+        fp = cfg.faults
+        for f in range(fp.n_faults):
+            k_faults, k1, k2, k3, k4, k5 = jax.random.split(k_faults, 6)
+            t = jnp.int32(fp.t_min_us) + (
+                jax.random.bits(k1, (), jnp.uint32) % jnp.uint32(fp.t_max_us - fp.t_min_us)
+            ).astype(jnp.int32)
+            dur = jnp.int32(fp.dur_min_us) + (
+                jax.random.bits(k2, (), jnp.uint32) % jnp.uint32(fp.dur_max_us - fp.dur_min_us)
+            ).astype(jnp.int32)
+            a = (jax.random.bits(k3, (), jnp.uint32) % jnp.uint32(n)).astype(jnp.int32)
+            b_off = 1 + (jax.random.bits(k4, (), jnp.uint32) % jnp.uint32(n - 1)).astype(jnp.int32)
+            b = (a + b_off) % n
+            if fp.allow_partition and fp.allow_kill:
+                is_part = (jax.random.bits(k5, (), jnp.uint32) % 2) == 0
+            elif fp.allow_partition:
+                is_part = jnp.bool_(True)
+            else:
+                is_part = jnp.bool_(False)
+            op_apply = jnp.where(is_part, F_CLOG_PAIR, F_KILL).astype(jnp.int32)
+            op_undo = jnp.where(is_part, F_UNCLOG_PAIR, F_RESTART).astype(jnp.int32)
+            for slot_off, (tt, op) in enumerate([(t, op_apply), (t + dur, op_undo)]):
+                i = n + 2 * f + slot_off
+                msk = slots == i
+                eq_time = jnp.where(msk, tt, eq_time)
+                eq_seq = jnp.where(msk, next_seq + slot_off, eq_seq)
+                eq_kind = jnp.where(msk, EV_FAULT, eq_kind)
+                eq_node = jnp.where(msk, a, eq_node)
+                pay = jnp.stack([op, a, b] + [jnp.int32(0)] * (p - 3))
+                eq_payload = jnp.where(msk[:, None], pay[None, :], eq_payload)
+                eq_valid = eq_valid | msk
+            next_seq += 2
+
+        return LaneState(
+            now_us=jnp.int32(0),
+            next_seq=jnp.int32(next_seq),
+            step=jnp.int32(0),
+            rng_key=key,
+            done=jnp.bool_(False),
+            failed=jnp.bool_(False),
+            fail_code=jnp.int32(OK),
+            horizon_hit=jnp.bool_(False),
+            msg_count=jnp.int32(0),
+            eq_time=eq_time,
+            eq_seq=eq_seq,
+            eq_kind=eq_kind,
+            eq_node=eq_node,
+            eq_src=eq_src,
+            eq_payload=eq_payload,
+            eq_valid=eq_valid,
+            clogged=jnp.zeros((n, n), bool),
+            killed=jnp.zeros((n,), bool),
+            nodes=nodes,
+        )
+
+    # -- one event per lane --------------------------------------------------
+
+    def lane_step(self, s: LaneState) -> LaneState:
+        m, cfg = self.machine, self.config
+
+        idx, any_valid = pop_earliest(s.eq_time, s.eq_seq, s.eq_valid)
+        ev_time = s.eq_time[idx]
+        ev_kind = s.eq_kind[idx]
+        ev_node = s.eq_node[idx]
+        ev_src = s.eq_src[idx]
+        ev_payload = s.eq_payload[idx]
+
+        new_now = jnp.maximum(s.now_us, ev_time)
+        horizon_hit = any_valid & (new_now >= cfg.horizon_us)
+        process = any_valid & ~horizon_hit
+        pop_mask = (jnp.arange(s.eq_valid.shape[0]) == idx) & any_valid
+        eq_valid = s.eq_valid & ~pop_mask
+
+        key, k_handler, k_restart, k_lat, k_drop = jax.random.split(s.rng_key, 5)
+        rand_u32 = jax.random.bits(k_handler, (cfg.handler_rand_words,), jnp.uint32)
+
+        node_alive = ~s.killed[ev_node]
+
+        def timer_branch(_):
+            nodes, outbox = m.on_timer(s.nodes, ev_node, ev_payload[0], new_now, rand_u32)
+            return nodes, outbox, s.clogged, s.killed, jnp.int32(-1)
+
+        def msg_branch(_):
+            nodes, outbox = m.on_message(s.nodes, ev_node, ev_src, ev_payload, new_now, rand_u32)
+            return nodes, outbox, s.clogged, s.killed, jnp.int32(-1)
+
+        def fault_branch(_):
+            op, a, b = ev_payload[0], ev_payload[1], ev_payload[2]
+            clog_val = op == F_CLOG_PAIR
+            touch_clog = (op == F_CLOG_PAIR) | (op == F_UNCLOG_PAIR)
+            clogged = jnp.where(
+                touch_clog,
+                set2d(set2d(s.clogged, a, b, clog_val), b, a, clog_val),
+                s.clogged,
+            )
+            a_mask = jnp.arange(s.killed.shape[0]) == a
+            killed = jnp.where(
+                op == F_KILL,
+                s.killed | a_mask,
+                jnp.where(op == F_RESTART, s.killed & ~a_mask, s.killed),
+            )
+            fresh = m.init_node(s.nodes, a, k_restart)
+            nodes = tree_where(op == F_RESTART, fresh, s.nodes)
+            boot_node = jnp.where(op == F_RESTART, a, jnp.int32(-1))
+            return nodes, m.empty_outbox(), clogged, killed, boot_node
+
+        nodes, outbox, clogged, killed, boot_node = lax.switch(
+            ev_kind, [timer_branch, msg_branch, fault_branch], None
+        )
+
+        # Killed nodes process nothing (reference: killed node's tasks are
+        # dropped); fault events always apply.
+        is_handler = ev_kind != EV_FAULT
+        effective = process & (node_alive | ~is_handler)
+        nodes = tree_where(effective, nodes, s.nodes)
+        clogged = jnp.where(effective, clogged, s.clogged)
+        killed = jnp.where(effective, killed, s.killed)
+        outbox_valid_msgs = outbox.msg_valid & effective
+        outbox_valid_timers = outbox.timer_valid & effective
+
+        # -- push outbox messages with chaos (latency / loss / clog) --------
+        eq = {
+            "time": s.eq_time,
+            "seq": s.eq_seq,
+            "kind": s.eq_kind,
+            "node": s.eq_node,
+            "src": s.eq_src,
+            "payload": s.eq_payload,
+            "valid": eq_valid,
+        }
+        next_seq = s.next_seq
+        failed = s.failed
+        fail_code = s.fail_code
+        msg_count = s.msg_count
+
+        lat_span = max(1, cfg.latency_max_us - cfg.latency_min_us)
+        lat_bits = jax.random.bits(k_lat, (m.MAX_MSGS,), jnp.uint32)
+        drop_bits = jax.random.bits(k_drop, (m.MAX_MSGS,), jnp.uint32)
+        loss_threshold = jnp.uint32(int(cfg.packet_loss_rate * 0xFFFFFFFF))
+
+        for mi in range(m.MAX_MSGS):
+            want = outbox_valid_msgs[mi]
+            dst = outbox.msg_dst[mi]
+            lost = drop_bits[mi] < loss_threshold
+            blocked = s.clogged[ev_node, dst] | lost
+            do_push = want & ~blocked
+            latency = jnp.int32(cfg.latency_min_us) + (
+                lat_bits[mi] % jnp.uint32(lat_span)
+            ).astype(jnp.int32)
+            slot, has_free = find_free_slot(eq["valid"])
+            overflow = do_push & ~has_free
+            failed = failed | overflow
+            fail_code = jnp.where(overflow, jnp.int32(OVERFLOW), fail_code)
+            do_push = do_push & has_free
+            eq = _push(eq, slot, do_push, new_now + latency, next_seq, EV_MSG, dst, ev_node, outbox.msg_payload[mi])
+            next_seq = next_seq + jnp.where(do_push, 1, 0)
+            msg_count = msg_count + jnp.where(do_push, 1, 0)
+
+        # -- push timers (for the handling node) ----------------------------
+        slot0 = jnp.arange(m.PAYLOAD_WIDTH) == 0
+        for ti in range(m.MAX_TIMERS):
+            want = outbox_valid_timers[ti]
+            slot, has_free = find_free_slot(eq["valid"])
+            overflow = want & ~has_free
+            failed = failed | overflow
+            fail_code = jnp.where(overflow, jnp.int32(OVERFLOW), fail_code)
+            want = want & has_free
+            tpay = jnp.where(slot0, outbox.timer_id[ti], 0).astype(jnp.int32)
+            eq = _push(
+                eq, slot, want, new_now + outbox.timer_delay_us[ti], next_seq,
+                EV_TIMER, ev_node, jnp.int32(-1), tpay,
+            )
+            next_seq = next_seq + jnp.where(want, 1, 0)
+
+        # -- restart boot timer ---------------------------------------------
+        want_boot = effective & (boot_node >= 0)
+        slot, has_free = find_free_slot(eq["valid"])
+        boot_overflow = want_boot & ~has_free
+        failed = failed | boot_overflow
+        fail_code = jnp.where(boot_overflow, jnp.int32(OVERFLOW), fail_code)
+        want_boot = want_boot & has_free
+        boot_pay = jnp.zeros((m.PAYLOAD_WIDTH,), jnp.int32)  # BOOT == 0
+        eq = _push(eq, slot, want_boot, new_now, next_seq, EV_TIMER, boot_node, jnp.int32(-1), boot_pay)
+        next_seq = next_seq + jnp.where(want_boot, 1, 0)
+
+        # -- invariants / termination ---------------------------------------
+        ok, code = m.invariant(nodes, new_now)
+        inv_fail = process & ~ok
+        failed = failed | inv_fail
+        fail_code = jnp.where(inv_fail, code, fail_code)
+        done = s.done | ~any_valid | horizon_hit | m.is_done(nodes, new_now)
+
+        return LaneState(
+            now_us=new_now,
+            next_seq=next_seq,
+            step=s.step + 1,
+            rng_key=key,
+            done=done,
+            failed=failed,
+            fail_code=fail_code,
+            horizon_hit=s.horizon_hit | horizon_hit,
+            msg_count=msg_count,
+            eq_time=eq["time"],
+            eq_seq=eq["seq"],
+            eq_kind=eq["kind"],
+            eq_node=eq["node"],
+            eq_src=eq["src"],
+            eq_payload=eq["payload"],
+            eq_valid=eq["valid"],
+            clogged=clogged,
+            killed=killed,
+            nodes=nodes,
+        )
+
+    # -- batch runners -------------------------------------------------------
+
+    def init_batch(self, seeds: jax.Array) -> LaneState:
+        return jax.vmap(self.init_lane)(seeds)
+
+    def step_batch(self, state: LaneState) -> LaneState:
+        new = jax.vmap(self.lane_step)(state)
+        active = ~(state.done | state.failed)
+        return tree_where(active, new, state)
+
+    def run_batch(self, seeds: jax.Array, max_steps: int = 10_000) -> BatchResult:
+        """Run every seed lane to completion (or max_steps events/lane).
+
+        jit-compile with `jax.jit(engine.run_batch, static_argnums=1)` or
+        use `make_runner`.
+        """
+        state = self.init_batch(seeds)
+
+        def cond(carry):
+            s, it = carry
+            return (it < max_steps) & jnp.any(~(s.done | s.failed))
+
+        def body(carry):
+            s, it = carry
+            return self.step_batch(s), it + 1
+
+        final, _ = lax.while_loop(cond, body, (state, jnp.int32(0)))
+        return BatchResult(
+            seeds=seeds,
+            done=final.done,
+            failed=final.failed,
+            fail_code=final.fail_code,
+            now_us=final.now_us,
+            steps=final.step,
+            msg_count=final.msg_count,
+            summary=jax.vmap(self.machine.summary)(final.nodes),
+        )
+
+    def make_runner(self, max_steps: int = 10_000, mesh=None):
+        """A jitted `seeds -> BatchResult`, optionally sharded over a mesh
+        axis "seeds" (lanes are embarrassingly parallel; XLA propagates
+        the sharding through the whole while_loop)."""
+        fn = jax.jit(partial(self.run_batch, max_steps=max_steps))
+        if mesh is None:
+            return fn
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P("seeds"))
+
+        def sharded(seeds):
+            seeds = jax.device_put(seeds, sharding)
+            return fn(seeds)
+
+        return sharded
+
+    def failing_seeds(self, result: BatchResult) -> jax.Array:
+        """Gather the failing lane seeds back to the host
+        (the only device->host traffic besides summaries)."""
+        return result.seeds[result.failed]
+
+
+def _push(eq, idx, do_push, time, seq, kind, node, src, payload):
+    """Masked-select write of one event into slot `idx` (no scatters)."""
+    m = (jnp.arange(eq["valid"].shape[0]) == idx) & do_push
+
+    def upd(arr, value):
+        return jnp.where(m, jnp.int32(value), arr)
+
+    return {
+        "time": upd(eq["time"], time),
+        "seq": upd(eq["seq"], seq),
+        "kind": upd(eq["kind"], kind),
+        "node": upd(eq["node"], node),
+        "src": upd(eq["src"], src),
+        "payload": jnp.where(m[:, None], payload[None, :], eq["payload"]),
+        "valid": eq["valid"] | m,
+    }

@@ -1,0 +1,162 @@
+"""Protocol state-machine authoring API for the TPU engine.
+
+The host engine runs free-form async Python (like the reference runs
+arbitrary futures). Arbitrary coroutines cannot run on TPU, so the TPU
+engine runs *protocol step functions*: a `Machine` is a pure, traceable
+transition system over fixed-shape jax arrays (SURVEY.md §7 "hard parts"
+item 3 — this authoring model is first-class).
+
+Per-lane calling convention (the engine vmaps over lanes):
+
+  * node state: a pytree whose every leaf has leading dim N (num nodes)
+  * handlers receive the whole pytree + a scalar node index and return
+    (new pytree, Outbox); use `update_node` / `.at[i]` scatters
+  * Outbox: fixed-width message/timer slots with validity masks — the
+    fixed-shape equivalent of the reference's dynamic spawn/send
+    (sim/net/mod.rs send path); invalid slots are ignored
+
+Timer id 0 (`BOOT`) is reserved: the engine delivers it to every node at
+t=0 and after every restart — machines schedule their initial timers in
+response (the analogue of NodeBuilder.init closures,
+reference: sim/runtime/mod.rs:359-375).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+BOOT = 0  # reserved timer id
+
+
+@struct.dataclass
+class Outbox:
+    """Fixed-capacity per-step outputs of a handler."""
+
+    msg_dst: jax.Array  # int32[M] destination node (-1 = invalid)
+    msg_payload: jax.Array  # int32[M, P]
+    msg_valid: jax.Array  # bool[M]
+    timer_delay_us: jax.Array  # int32[T]
+    timer_id: jax.Array  # int32[T]
+    timer_valid: jax.Array  # bool[T]
+
+
+def empty_outbox(max_msgs: int, max_timers: int, payload_width: int) -> Outbox:
+    return Outbox(
+        msg_dst=jnp.full((max_msgs,), -1, jnp.int32),
+        msg_payload=jnp.zeros((max_msgs, payload_width), jnp.int32),
+        msg_valid=jnp.zeros((max_msgs,), bool),
+        timer_delay_us=jnp.zeros((max_timers,), jnp.int32),
+        timer_id=jnp.zeros((max_timers,), jnp.int32),
+        timer_valid=jnp.zeros((max_timers,), bool),
+    )
+
+
+# All writes below are mask-based `where` selects rather than scatters:
+# scatters with traced indices are hostile to the TPU vectorizer (and the
+# axon compiler rejects multi-index forms outright), while a masked select
+# over a small fixed axis is pure VPU work.
+
+
+def _slot_mask(n: int, slot) -> jax.Array:
+    return jnp.arange(n) == slot
+
+
+def send(outbox: Outbox, slot: int, dst, payload) -> Outbox:
+    """Set message slot `slot`."""
+    return send_if(outbox, slot, jnp.bool_(True), dst, payload)
+
+
+def send_if(outbox: Outbox, slot: int, cond, dst, payload) -> Outbox:
+    """Conditionally set message slot `slot` (traced condition)."""
+    m = _slot_mask(outbox.msg_dst.shape[0], slot) & cond
+    return outbox.replace(
+        msg_dst=jnp.where(m, jnp.int32(dst), outbox.msg_dst),
+        msg_payload=jnp.where(m[:, None], payload[None, :], outbox.msg_payload),
+        msg_valid=outbox.msg_valid | m,
+    )
+
+
+def set_timer(outbox: Outbox, slot: int, delay_us, timer_id) -> Outbox:
+    return set_timer_if(outbox, slot, jnp.bool_(True), delay_us, timer_id)
+
+
+def set_timer_if(outbox: Outbox, slot: int, cond, delay_us, timer_id) -> Outbox:
+    m = _slot_mask(outbox.timer_id.shape[0], slot) & cond
+    return outbox.replace(
+        timer_delay_us=jnp.where(m, jnp.int32(delay_us), outbox.timer_delay_us),
+        timer_id=jnp.where(m, jnp.int32(timer_id), outbox.timer_id),
+        timer_valid=outbox.timer_valid | m,
+    )
+
+
+def set_at(arr: jax.Array, i, value) -> jax.Array:
+    """`arr.at[i].set(value)` for traced i, as a masked select."""
+    mask = jnp.arange(arr.shape[0]) == i
+    while mask.ndim < arr.ndim:
+        mask = mask[..., None]
+    return jnp.where(mask, value, arr)
+
+
+def update_node(nodes: Any, i, **updates) -> Any:
+    """Write per-field updates into node i of a state dataclass."""
+    return nodes.replace(**{k: set_at(getattr(nodes, k), i, v) for k, v in updates.items()})
+
+
+def make_payload(width: int, *vals) -> jax.Array:
+    """Pack scalars into a fixed-width int32 payload vector."""
+    parts = [jnp.asarray(v, jnp.int32) for v in vals]
+    parts += [jnp.int32(0)] * (width - len(parts))
+    return jnp.stack(parts)
+
+
+class Machine:
+    """Base class: subclass and override the handlers.
+
+    Class attributes to set:
+      NUM_NODES, PAYLOAD_WIDTH, MAX_MSGS, MAX_TIMERS
+    """
+
+    NUM_NODES: int = 1
+    PAYLOAD_WIDTH: int = 4
+    MAX_MSGS: int = 4
+    MAX_TIMERS: int = 2
+
+    def empty_outbox(self) -> Outbox:
+        return empty_outbox(self.MAX_MSGS, self.MAX_TIMERS, self.PAYLOAD_WIDTH)
+
+    # -- required overrides --------------------------------------------------
+
+    def init(self, rng_key) -> Any:
+        """Initial node-state pytree (every leaf leading dim NUM_NODES)."""
+        raise NotImplementedError
+
+    def init_node(self, nodes: Any, i, rng_key) -> Any:
+        """Reset node i to its initial state (used on restart faults).
+        Default: re-derive from init() and copy row i."""
+        fresh = self.init(rng_key)
+        return jax.tree.map(lambda cur, f: set_at(cur, i, f[i]), nodes, fresh)
+
+    def on_timer(self, nodes: Any, node, timer_id, now_us, rand_u32) -> Tuple[Any, Outbox]:
+        raise NotImplementedError
+
+    def on_message(self, nodes: Any, node, src, payload, now_us, rand_u32) -> Tuple[Any, Outbox]:
+        raise NotImplementedError
+
+    # -- optional overrides --------------------------------------------------
+
+    def invariant(self, nodes: Any, now_us) -> Tuple[jax.Array, jax.Array]:
+        """(ok: bool, code: int32). A False freezes the lane as FAILED —
+        the on-device analogue of a failing assertion in a #[madsim::test]."""
+        return jnp.bool_(True), jnp.int32(0)
+
+    def is_done(self, nodes: Any, now_us) -> jax.Array:
+        """Early-success predicate (lane stops exploring)."""
+        return jnp.bool_(False)
+
+    def summary(self, nodes: Any) -> Any:
+        """Small pytree gathered back to host per lane."""
+        return jnp.int32(0)

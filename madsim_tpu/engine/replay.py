@@ -1,0 +1,94 @@
+"""Bit-identical single-lane replay on the host CPU — the debugger path.
+
+The TPU batch explores thousands of seeds; any failing seed is re-run
+here, eagerly, one event at a time, with a full event trace the user can
+print, filter, or step through. Because the replay executes the *same*
+jax ops (threefry draws, int32 time math, argmin pops) outside jit on
+CPU, the outcome is bit-identical to the lane's on-device execution —
+the property the reference gets from reproduce-by-seed
+(madsim/src/sim/runtime/mod.rs:205-210), upgraded to cross-engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional
+
+import jax
+
+from ..ops import pop_earliest
+from .core import EV_FAULT, EV_MSG, EV_TIMER, Engine, LaneState
+
+_KIND_NAMES = {EV_TIMER: "timer", EV_MSG: "msg", EV_FAULT: "fault"}
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    step: int
+    time_us: int
+    kind: str
+    node: int
+    src: int
+    payload: tuple
+
+    def __repr__(self) -> str:
+        src = f" src={self.src}" if self.kind == "msg" else ""
+        return (
+            f"[{self.time_us:>10}us] #{self.step:<5} {self.kind:<5} "
+            f"node={self.node}{src} payload={list(self.payload)}"
+        )
+
+
+@dataclasses.dataclass
+class ReplayResult:
+    state: LaneState
+    trace: List[TraceEvent]
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.state.failed)
+
+    @property
+    def fail_code(self) -> int:
+        return int(self.state.fail_code)
+
+
+def replay(
+    engine: Engine,
+    seed: int,
+    max_steps: int = 10_000,
+    on_step: Optional[Callable[[TraceEvent, LaneState], None]] = None,
+    trace: bool = True,
+) -> ReplayResult:
+    """Replay one seed eagerly on CPU with a full event trace.
+
+    `on_step(event, state)` is the debugging hook: runs as plain Python
+    after every event — print, assert, drop into pdb, anything.
+    """
+    cpus = jax.devices("cpu")
+    with jax.default_device(cpus[0]):
+        state = engine.init_lane(seed)
+        # jit the single-lane step: still bit-identical (XLA integer ops are
+        # exact and threefry is backend-stable), but the replay materializes
+        # the full state between events so hooks can inspect anything.
+        step_fn = jax.jit(engine.lane_step)
+        events: List[TraceEvent] = []
+        step = 0
+        while not bool(state.done | state.failed) and step < max_steps:
+            idx, any_valid = pop_earliest(state.eq_time, state.eq_seq, state.eq_valid)
+            ev = TraceEvent(
+                step=step,
+                time_us=int(state.eq_time[idx]),
+                kind=_KIND_NAMES.get(int(state.eq_kind[idx]), "?"),
+                node=int(state.eq_node[idx]),
+                src=int(state.eq_src[idx]),
+                payload=tuple(int(x) for x in state.eq_payload[idx]),
+            ) if bool(any_valid) else None
+            state = step_fn(state)
+            if ev is not None:
+                if trace:
+                    events.append(ev)
+                if on_step is not None:
+                    on_step(ev, state)
+            step += 1
+        return ReplayResult(state=state, trace=events)

@@ -1,0 +1,416 @@
+"""Simulated gRPC over the message fabric (reference: madsim-tonic).
+
+Same architecture as the reference's tonic shim: no HTTP/2, no protobuf
+serialization — a "call" is one `connect1` exchange carrying
+(path, server_streaming flag, request object) and response objects
+streamed back terminated by an end-of-stream marker
+(reference: madsim-tonic/src/transport/server.rs:210-336, client.rs:38-110,
+message-type matrix comment client.rs:33-37). Messages move between sim
+nodes as Python objects, zero-copy, like the reference's `Box<dyn Any>`.
+
+The reference generates client/server stubs with a forked tonic-build
+(madsim-tonic-build); Python needs no codegen — `@service("pkg.Name")`
+plus `@unary` / `@client_streaming` / `@server_streaming` / `@streaming`
+decorators define the same four call shapes.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+from .. import _context
+from ..errors import SimError
+from ..net import Endpoint, lookup_host
+from ..net.endpoint import PayloadReceiver, PayloadSender
+from ..net.network import ConnectionRefused, ConnectionReset, parse_addr
+
+__all__ = [
+    "Server",
+    "Router",
+    "Channel",
+    "connect",
+    "Status",
+    "Code",
+    "Request",
+    "Response",
+    "Streaming",
+    "service",
+    "unary",
+    "client_streaming",
+    "server_streaming",
+    "streaming",
+]
+
+_EOS = ("__eos__",)  # end-of-stream marker (reference streams `()` as terminator)
+
+
+class Code:
+    """gRPC status codes (subset; reference: tonic::Code)."""
+
+    OK = 0
+    CANCELLED = 1
+    UNKNOWN = 2
+    INVALID_ARGUMENT = 3
+    DEADLINE_EXCEEDED = 4
+    NOT_FOUND = 5
+    ALREADY_EXISTS = 6
+    PERMISSION_DENIED = 7
+    RESOURCE_EXHAUSTED = 8
+    FAILED_PRECONDITION = 9
+    ABORTED = 10
+    UNIMPLEMENTED = 12
+    INTERNAL = 13
+    UNAVAILABLE = 14
+
+
+class Status(SimError):
+    """RPC error status (reference: tonic::Status)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"status {code}: {message}")
+        self.code = code
+        self.message = message
+
+    @staticmethod
+    def unavailable(msg: str) -> "Status":
+        return Status(Code.UNAVAILABLE, msg)
+
+    @staticmethod
+    def not_found(msg: str) -> "Status":
+        return Status(Code.NOT_FOUND, msg)
+
+    @staticmethod
+    def unimplemented(msg: str) -> "Status":
+        return Status(Code.UNIMPLEMENTED, msg)
+
+    @staticmethod
+    def internal(msg: str) -> "Status":
+        return Status(Code.INTERNAL, msg)
+
+
+class Request:
+    """Request wrapper (reference: tonic::Request)."""
+
+    def __init__(self, message: Any):
+        self.message = message
+        self.metadata: Dict[str, str] = {}
+
+    def into_inner(self) -> Any:
+        return self.message
+
+
+class Response:
+    """Response wrapper (reference: tonic::Response)."""
+
+    def __init__(self, message: Any):
+        self.message = message
+        self.metadata: Dict[str, str] = {}
+
+    def into_inner(self) -> Any:
+        return self.message
+
+
+class Streaming:
+    """Async response/request stream (reference: madsim-tonic/src/codec.rs)."""
+
+    def __init__(self, rx: PayloadReceiver):
+        self._rx = rx
+        self._done = False
+
+    def __aiter__(self) -> "Streaming":
+        return self
+
+    async def __anext__(self) -> Any:
+        item = await self.message()
+        if item is None:
+            raise StopAsyncIteration
+        return item
+
+    async def message(self) -> Optional[Any]:
+        """Next message or None at end of stream."""
+        if self._done:
+            return None
+        item = await self._rx.recv()
+        if item is None or item == _EOS:
+            self._done = True
+            return None
+        if isinstance(item, Status):
+            self._done = True
+            raise item
+        return item
+
+
+# -- service definition (codegen replacement) --------------------------------
+
+SHAPE_UNARY = "unary"
+SHAPE_CLIENT_STREAMING = "client_streaming"
+SHAPE_SERVER_STREAMING = "server_streaming"
+SHAPE_STREAMING = "streaming"
+
+
+def _mark(shape: str):
+    def deco(fn):
+        fn.__grpc_shape__ = shape
+        return fn
+
+    return deco
+
+
+unary = _mark(SHAPE_UNARY)
+client_streaming = _mark(SHAPE_CLIENT_STREAMING)
+server_streaming = _mark(SHAPE_SERVER_STREAMING)
+streaming = _mark(SHAPE_STREAMING)
+
+
+def _camel(name: str) -> str:
+    return "".join(part.capitalize() for part in name.split("_"))
+
+
+def service(service_name: str):
+    """Class decorator: registers `@unary`/`@streaming`-marked methods
+    under "/{service_name}/{CamelCaseMethod}" paths."""
+
+    def deco(cls):
+        methods: Dict[str, tuple] = {}
+        for name in dir(cls):
+            fn = getattr(cls, name, None)
+            shape = getattr(fn, "__grpc_shape__", None)
+            if shape is not None:
+                methods[_camel(name)] = (name, shape)
+        cls.__grpc_service_name__ = service_name
+        cls.__grpc_methods__ = methods
+        return cls
+
+    return deco
+
+
+# -- server ------------------------------------------------------------------
+
+
+class Server:
+    """Reference: madsim-tonic transport::Server builder (the ~20 HTTP/2
+    tuning knobs are accepted and ignored, like the reference)."""
+
+    @staticmethod
+    def builder() -> "Router":
+        return Router()
+
+
+class Router:
+    """Reference: transport/server.rs `Router`."""
+
+    def __init__(self) -> None:
+        self._services: Dict[str, Any] = {}
+
+    # no-op HTTP/2 config surface (parity with the reference's builder)
+    def timeout(self, *_a, **_k) -> "Router":
+        return self
+
+    def concurrency_limit_per_connection(self, *_a, **_k) -> "Router":
+        return self
+
+    def tcp_nodelay(self, *_a, **_k) -> "Router":
+        return self
+
+    def http2_keepalive_interval(self, *_a, **_k) -> "Router":
+        return self
+
+    def max_frame_size(self, *_a, **_k) -> "Router":
+        return self
+
+    def add_service(self, svc: Any) -> "Router":
+        name = getattr(type(svc), "__grpc_service_name__", None)
+        if name is None:
+            raise SimError(f"{type(svc).__name__} is not a @grpc.service class")
+        self._services[name] = svc
+        return self
+
+    async def serve(self, addr: Any) -> None:
+        await self.serve_with_shutdown(addr, None)
+
+    async def serve_with_shutdown(self, addr: Any, shutdown) -> None:
+        """Accept loop: one task per request
+        (reference: server.rs:217-240 serve_with_shutdown)."""
+        from ..task import spawn
+
+        ep = await Endpoint.bind(addr)
+        serve_task = spawn(self._accept_loop(ep), name="grpc-serve")
+        if shutdown is None:
+            await serve_task
+        else:
+            shutdown_task = spawn(shutdown, name="grpc-shutdown") if inspect.iscoroutine(shutdown) else shutdown
+            await shutdown_task
+            serve_task.abort()
+            ep.close()
+
+    async def _accept_loop(self, ep: Endpoint) -> None:
+        from ..task import spawn
+
+        while True:
+            tx, rx, peer = await ep.accept1()
+            spawn(self._handle(tx, rx, peer), name="grpc-conn")
+
+    async def _handle(self, tx: PayloadSender, rx: PayloadReceiver, peer) -> None:
+        """Decode (path, server_streaming, request), route by service name,
+        stream responses terminated by EOS (reference: server.rs:232-334)."""
+        head = await rx.recv()
+        if head is None:
+            return
+        path, _server_streaming, shape, first = head
+        try:
+            _, svc_name, method = path.split("/")
+        except ValueError:
+            tx.send(Status(Code.INVALID_ARGUMENT, f"bad path {path!r}"))
+            return
+        svc = self._services.get(svc_name)
+        if svc is None:
+            tx.send(Status.unimplemented(f"unknown service {svc_name}"))
+            return
+        entry = type(svc).__grpc_methods__.get(method)
+        if entry is None:
+            tx.send(Status.unimplemented(f"unknown method {method} on {svc_name}"))
+            return
+        attr, decl_shape = entry
+        handler = getattr(svc, attr)
+        try:
+            if decl_shape == SHAPE_UNARY:
+                rsp = await handler(Request(first))
+                tx.send(rsp.into_inner() if isinstance(rsp, Response) else rsp)
+            elif decl_shape == SHAPE_CLIENT_STREAMING:
+                rsp = await handler(Streaming(rx))
+                tx.send(rsp.into_inner() if isinstance(rsp, Response) else rsp)
+            elif decl_shape == SHAPE_SERVER_STREAMING:
+                async for item in handler(Request(first)):
+                    tx.send(item)
+            else:  # bidi
+                async for item in handler(Streaming(rx)):
+                    tx.send(item)
+        except Status as status:
+            tx.send(status)
+            return
+        except (ConnectionReset, ConnectionRefused):
+            return
+        except Exception as exc:  # noqa: BLE001 - handler panic -> INTERNAL
+            tx.send(Status.internal(repr(exc)))
+            return
+        tx.send(_EOS)
+
+
+# -- client ------------------------------------------------------------------
+
+
+class Channel:
+    """Client channel (reference: transport/channel.rs `Endpoint`/`Channel`).
+
+    connect = DNS lookup + ephemeral bind; `timeout` honored on calls,
+    other knobs ignored (reference: channel.rs:23-140)."""
+
+    def __init__(self, target: str, timeout: Optional[float] = None):
+        self._target = target
+        self._timeout = timeout
+        self._ep: Optional[Endpoint] = None
+        self._addr = None
+
+    async def _connect(self) -> None:
+        target = self._target
+        if target.startswith("http://") or target.startswith("https://"):
+            target = target.split("://", 1)[1]
+        results = await lookup_host(target)
+        self._addr = parse_addr(results[0])
+        self._ep = await Endpoint.bind(("0.0.0.0", 0))
+        # handshake: verify the server is reachable (reference connect1
+        # handshake at channel.rs:74-108)
+        tx, rx = await self._ep.connect1(self._addr)
+        tx.close()
+
+    async def _open(self, path: str, shape: str, first: Any):
+        assert self._ep is not None
+        tx, rx = await self._ep.connect1(self._addr)
+        tx.send((path, shape in (SHAPE_SERVER_STREAMING, SHAPE_STREAMING), shape, first))
+        return tx, rx
+
+    async def unary(self, path: str, msg: Any) -> Any:
+        """Reference: client.rs Grpc::unary."""
+        from ..time import timeout as time_timeout
+
+        async def go():
+            tx, rx = await self._open(path, SHAPE_UNARY, msg)
+            rsp = await rx.recv()
+            if isinstance(rsp, Status):
+                raise rsp
+            if rsp is None:
+                raise Status.unavailable("connection closed")
+            return rsp
+
+        if self._timeout is not None:
+            return await time_timeout(self._timeout, go())
+        return await go()
+
+    async def client_streaming(self, path: str, messages) -> Any:
+        from ..time import timeout as time_timeout
+
+        async def go():
+            tx, rx = await self._open(path, SHAPE_CLIENT_STREAMING, None)
+            async for m in _aiter(messages):
+                tx.send(m)
+            tx.send(_EOS)
+            rsp = await rx.recv()
+            if isinstance(rsp, Status):
+                raise rsp
+            if rsp is None:
+                raise Status.unavailable("connection closed")
+            return rsp
+
+        if self._timeout is not None:
+            return await time_timeout(self._timeout, go())
+        return await go()
+
+    async def server_streaming(self, path: str, msg: Any) -> Streaming:
+        """The channel timeout covers stream *setup*; per-message read
+        deadlines are the caller's (wrap `stream.message()` in
+        `time.timeout`), matching tonic where the timeout is per-request
+        not per-stream-element."""
+        from ..time import timeout as time_timeout
+
+        if self._timeout is not None:
+            tx, rx = await time_timeout(
+                self._timeout, self._open(path, SHAPE_SERVER_STREAMING, msg)
+            )
+        else:
+            tx, rx = await self._open(path, SHAPE_SERVER_STREAMING, msg)
+        return Streaming(rx)
+
+    async def streaming(self, path: str, messages) -> Streaming:
+        from ..task import spawn
+
+        tx, rx = await self._open(path, SHAPE_STREAMING, None)
+
+        async def feed():
+            async for m in _aiter(messages):
+                tx.send(m)
+            tx.send(_EOS)
+
+        spawn(feed(), name="grpc-feed")
+        return Streaming(rx)
+
+
+async def connect(target: str, timeout: Optional[float] = None) -> Channel:
+    """Connect a channel (reference: Endpoint::connect).
+
+    Raises `Status(UNAVAILABLE)` if the server is unreachable."""
+    ch = Channel(target, timeout=timeout)
+    try:
+        await ch._connect()
+    except (ConnectionRefused, ConnectionReset, OSError) as exc:
+        raise Status.unavailable(str(exc)) from exc
+    return ch
+
+
+async def _aiter(it) -> AsyncIterator[Any]:
+    if hasattr(it, "__aiter__"):
+        async for x in it:
+            yield x
+    else:
+        for x in it:
+            yield x

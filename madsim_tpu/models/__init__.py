@@ -1,0 +1,11 @@
+"""Protocol models for the TPU engine.
+
+`echo` — 2-node request/response (the tonic-example-class workload,
+reference: tonic-example/tests/test.rs:22-120).
+`raft` — MadRaft-class leader election + log replication, the flagship
+benchmark workload (BASELINE.json configs).
+"""
+
+from . import echo, raft
+
+__all__ = ["echo", "raft"]

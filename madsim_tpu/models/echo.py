@@ -1,0 +1,96 @@
+"""Echo / hello-RPC machine: client pings, server echoes, K rounds.
+
+The TPU-engine twin of the tonic-example hello workload
+(reference: tonic-example/src/lib.rs:13-120 unary path): node 0 is the
+client, node 1 the server. Client sends PING(n) on boot and after each
+reply; done when K replies received. Invariant: replies arrive in order.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..engine.machine import BOOT, Machine, Outbox, make_payload, send_if, set_timer_if, update_node
+
+PING = 1
+PONG = 2
+
+CLIENT = 0
+SERVER = 1
+
+# fail codes
+BAD_ORDER = 100
+
+
+@struct.dataclass
+class EchoState:
+    sent: jax.Array  # int32[N] pings sent (client)
+    acked: jax.Array  # int32[N] replies received in order (client)
+    served: jax.Array  # int32[N] pings served (server)
+    bad: jax.Array  # bool[N] ordering violation observed
+
+
+class EchoMachine(Machine):
+    NUM_NODES = 2
+    PAYLOAD_WIDTH = 4
+    MAX_MSGS = 1
+    MAX_TIMERS = 1
+
+    def __init__(self, rounds: int = 10, retry_us: int = 100_000):
+        self.rounds = rounds
+        self.retry_us = retry_us
+
+    def init(self, rng_key) -> EchoState:
+        z = jnp.zeros((self.NUM_NODES,), jnp.int32)
+        return EchoState(sent=z, acked=z, served=z, bad=jnp.zeros((self.NUM_NODES,), bool))
+
+    def on_timer(self, nodes: EchoState, node, timer_id, now_us, rand_u32) -> Tuple[EchoState, Outbox]:
+        outbox = self.empty_outbox()
+        is_client = node == CLIENT
+        # BOOT or retry timer: (re)send the current ping.
+        seq = nodes.acked[CLIENT]
+        payload = make_payload(self.PAYLOAD_WIDTH, PING, seq)
+        want = is_client & (seq < self.rounds)
+        outbox = send_if(outbox, 0, want, SERVER, payload)
+        outbox = set_timer_if(outbox, 0, want, self.retry_us, 1)  # retry on loss
+        nodes = update_node(nodes, CLIENT, sent=jnp.where(want, nodes.sent[CLIENT] + 1, nodes.sent[CLIENT]))
+        return nodes, outbox
+
+    def on_message(self, nodes: EchoState, node, src, payload, now_us, rand_u32) -> Tuple[EchoState, Outbox]:
+        outbox = self.empty_outbox()
+        mtype, seq = payload[0], payload[1]
+
+        # Server: echo back.
+        is_ping = (node == SERVER) & (mtype == PING)
+        pong = make_payload(self.PAYLOAD_WIDTH, PONG, seq)
+        outbox = send_if(outbox, 0, is_ping, CLIENT, pong)
+        nodes = update_node(
+            nodes, SERVER, served=jnp.where(is_ping, nodes.served[SERVER] + 1, nodes.served[SERVER])
+        )
+
+        # Client: accept in-order reply (retries make duplicates possible;
+        # ahead-of-order is a protocol violation).
+        is_pong = (node == CLIENT) & (mtype == PONG)
+        in_order = seq == nodes.acked[CLIENT]
+        ahead = seq > nodes.acked[CLIENT]
+        nodes = update_node(
+            nodes,
+            CLIENT,
+            acked=jnp.where(is_pong & in_order, nodes.acked[CLIENT] + 1, nodes.acked[CLIENT]),
+            bad=nodes.bad[CLIENT] | (is_pong & ahead),
+        )
+        return nodes, outbox
+
+    def invariant(self, nodes: EchoState, now_us):
+        ok = ~jnp.any(nodes.bad)
+        return ok, jnp.where(ok, 0, BAD_ORDER).astype(jnp.int32)
+
+    def is_done(self, nodes: EchoState, now_us):
+        return nodes.acked[CLIENT] >= self.rounds
+
+    def summary(self, nodes: EchoState):
+        return {"acked": nodes.acked[CLIENT], "served": nodes.served[SERVER]}

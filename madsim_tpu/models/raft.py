@@ -1,0 +1,423 @@
+"""Raft leader election + log replication as a TPU-engine Machine.
+
+The MadRaft-class flagship workload (BASELINE.json: "MadRaft 3-node
+leader election" / "5-node log replication + partition injection").
+Single-entry AppendEntries, randomized election timeouts, heartbeats,
+client appends modeled as a leader-side timer. Safe under partition AND
+kill/restart chaos: term/votedFor/log survive restarts (stable storage),
+volatile state resets — so `FaultPlan(allow_kill=True)` exercises true
+crash-recovery.
+
+On-device invariants (checked after every event):
+  * ElectionSafety (code 101): at most one leader per term
+  * LogMatching on committed prefixes (code 102)
+  * CommitMonotonicity is implied by construction (commit only grows)
+
+Timer ids are epoch-encoded (`tid = base + 4*epoch[node]`): a restart
+bumps the node's epoch at BOOT so timer chains from a previous
+incarnation die instead of double-arming — the fixed-shape analogue of
+the reference dropping a killed node's timers with its futures
+(madsim/src/sim/task/mod.rs:133-140).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from ..engine.machine import Machine, Outbox, make_payload, send_if, set_at, set_timer_if, update_node
+from ..utils import set2d
+
+# roles
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+# message types (payload[0])
+M_RV, M_VOTE, M_AE, M_AER = 1, 2, 3, 4
+
+# timer bases (payload[0] = base + 4*epoch; base 0 = engine BOOT)
+T_BOOT, T_ELECTION, T_HEARTBEAT, T_CLIENT = 0, 1, 2, 3
+
+# invariant failure codes
+ELECTION_SAFETY = 101
+LOG_MATCHING = 102
+
+ELECTION_MIN_US = 150_000
+ELECTION_MAX_US = 300_000
+HEARTBEAT_US = 50_000
+CLIENT_APPEND_US = 30_000
+
+
+@struct.dataclass
+class RaftState:
+    # persistent (survives restart — stable storage)
+    term: jax.Array  # int32[N]
+    voted_for: jax.Array  # int32[N], -1 = none
+    log_term: jax.Array  # int32[N, CAP+1]; slot 0 is the 0-sentinel
+    log_len: jax.Array  # int32[N]
+    epoch: jax.Array  # int32[N] timer epoch (persistent, bumped at BOOT)
+    # volatile
+    role: jax.Array  # int32[N]
+    votes: jax.Array  # int32[N]
+    elec_deadline: jax.Array  # int32[N] us
+    commit: jax.Array  # int32[N]
+    next_idx: jax.Array  # int32[N, N]
+    match_idx: jax.Array  # int32[N, N]
+
+
+class RaftMachine(Machine):
+    PAYLOAD_WIDTH = 6
+    MAX_TIMERS = 2
+
+    def __init__(self, num_nodes: int = 5, log_capacity: int = 8):
+        self.NUM_NODES = num_nodes
+        self.MAX_MSGS = num_nodes - 1
+        self.log_capacity = log_capacity
+        self.majority = num_nodes // 2 + 1
+
+    # -- state ---------------------------------------------------------------
+
+    def init(self, rng_key) -> RaftState:
+        n, cap = self.NUM_NODES, self.log_capacity
+        z = jnp.zeros((n,), jnp.int32)
+        return RaftState(
+            term=z,
+            voted_for=jnp.full((n,), -1, jnp.int32),
+            log_term=jnp.zeros((n, cap + 1), jnp.int32),
+            log_len=z,
+            epoch=z,
+            role=z,
+            votes=z,
+            elec_deadline=z,
+            commit=z,
+            next_idx=jnp.ones((n, n), jnp.int32),
+            match_idx=jnp.zeros((n, n), jnp.int32),
+        )
+
+    def init_node(self, nodes: RaftState, i, rng_key) -> RaftState:
+        """Restart: persistent state survives, volatile resets
+        (Raft §5.1 stable storage semantics)."""
+        n = self.NUM_NODES
+        return nodes.replace(
+            role=set_at(nodes.role, i, FOLLOWER),
+            votes=set_at(nodes.votes, i, 0),
+            elec_deadline=set_at(nodes.elec_deadline, i, 0),
+            commit=set_at(nodes.commit, i, 0),
+            next_idx=set_at(nodes.next_idx, i, jnp.ones((n,), jnp.int32)),
+            match_idx=set_at(nodes.match_idx, i, jnp.zeros((n,), jnp.int32)),
+        )
+
+    # -- helpers -------------------------------------------------------------
+
+    def _peers(self, node):
+        """The NUM_NODES-1 other node ids, as a static-shape vector."""
+        n = self.NUM_NODES
+        offs = jnp.arange(1, n, dtype=jnp.int32)
+        return (node + offs) % n
+
+    def _rand_timeout(self, rand_word):
+        span = jnp.uint32(ELECTION_MAX_US - ELECTION_MIN_US)
+        return jnp.int32(ELECTION_MIN_US) + (rand_word % span).astype(jnp.int32)
+
+    def _pay(self, *vals):
+        return make_payload(self.PAYLOAD_WIDTH, *vals)
+
+    def _tid(self, nodes, node, base):
+        return jnp.int32(base) + 4 * nodes.epoch[node]
+
+    # -- timers --------------------------------------------------------------
+
+    def on_timer(self, nodes: RaftState, node, timer_id, now_us, rand_u32) -> Tuple[RaftState, Outbox]:
+        outbox = self.empty_outbox()
+        base = timer_id % 4
+        t_epoch = timer_id // 4
+        # BOOT (engine-raw id 0) always valid; others require current epoch.
+        is_boot = timer_id == T_BOOT
+        live = is_boot | (t_epoch == nodes.epoch[node])
+
+        # ---- BOOT: bump epoch, arm election + client timers ----
+        new_epoch = jnp.where(is_boot & live, nodes.epoch[node] + 1, nodes.epoch[node])
+        nodes = update_node(nodes, node, epoch=new_epoch)
+        timeout = self._rand_timeout(rand_u32[0])
+        boot_deadline = now_us + timeout
+        nodes = update_node(
+            nodes, node,
+            elec_deadline=jnp.where(is_boot & live, boot_deadline, nodes.elec_deadline[node]),
+        )
+        outbox = set_timer_if(outbox, 0, is_boot & live, timeout, self._tid(nodes, node, T_ELECTION))
+        outbox = set_timer_if(outbox, 1, is_boot & live, CLIENT_APPEND_US, self._tid(nodes, node, T_CLIENT))
+
+        # ---- ELECTION ----
+        is_elec = live & (base == T_ELECTION) & ~is_boot
+        not_yet = now_us < nodes.elec_deadline[node]
+        # re-arm at the postponed deadline (heartbeats push it forward)
+        rearm_delay = jnp.maximum(nodes.elec_deadline[node] - now_us, 1)
+        outbox = set_timer_if(outbox, 0, is_elec & not_yet, rearm_delay, self._tid(nodes, node, T_ELECTION))
+
+        start = is_elec & ~not_yet & (nodes.role[node] != LEADER)
+        new_term = nodes.term[node] + 1
+        timeout2 = self._rand_timeout(rand_u32[1])
+        nodes = update_node(
+            nodes, node,
+            term=jnp.where(start, new_term, nodes.term[node]),
+            role=jnp.where(start, CANDIDATE, nodes.role[node]),
+            voted_for=jnp.where(start, node, nodes.voted_for[node]),
+            votes=jnp.where(start, 1, nodes.votes[node]),
+            elec_deadline=jnp.where(start, now_us + timeout2, nodes.elec_deadline[node]),
+        )
+        outbox = set_timer_if(
+            outbox, 0, is_elec & ~not_yet, timeout2, self._tid(nodes, node, T_ELECTION)
+        )
+        last_idx = nodes.log_len[node]
+        last_term = nodes.log_term[node, last_idx]
+        rv = self._pay(M_RV, nodes.term[node], node, last_idx, last_term)
+        peers = self._peers(node)
+        for s in range(self.MAX_MSGS):
+            outbox = send_if(outbox, s, start, peers[s], rv)
+
+        # ---- HEARTBEAT (leader replicates) ----
+        is_hb = live & (base == T_HEARTBEAT) & ~is_boot
+        is_leader = nodes.role[node] == LEADER
+        do_hb = is_hb & is_leader
+        outbox = set_timer_if(outbox, 1, do_hb, HEARTBEAT_US, self._tid(nodes, node, T_HEARTBEAT))
+        for s in range(self.MAX_MSGS):
+            peer = peers[s]
+            ni = nodes.next_idx[node, peer]
+            prev_idx = ni - 1
+            prev_term = nodes.log_term[node, prev_idx]
+            has_entry = ni <= nodes.log_len[node]
+            entry_term = jnp.where(has_entry, nodes.log_term[node, jnp.minimum(ni, self.log_capacity)], 0)
+            ae = self._pay(M_AE, nodes.term[node], prev_idx, prev_term, entry_term, nodes.commit[node])
+            outbox = send_if(outbox, s, do_hb, peer, ae)
+
+        # ---- CLIENT (leader appends an entry) ----
+        is_client = live & (base == T_CLIENT) & ~is_boot
+        outbox = set_timer_if(outbox, 1, is_client & ~do_hb, CLIENT_APPEND_US, self._tid(nodes, node, T_CLIENT))
+        can_append = is_client & is_leader & (nodes.log_len[node] < self.log_capacity)
+        new_len = nodes.log_len[node] + 1
+        nodes = update_node(
+            nodes, node,
+            log_len=jnp.where(can_append, new_len, nodes.log_len[node]),
+            log_term=jnp.where(
+                can_append,
+                set_at(
+                    nodes.log_term[node],
+                    jnp.minimum(new_len, self.log_capacity),
+                    nodes.term[node],
+                ),
+                nodes.log_term[node],
+            ),
+        )
+        nodes = nodes.replace(
+            match_idx=jnp.where(
+                can_append,
+                set2d(nodes.match_idx, node, node, new_len),
+                nodes.match_idx,
+            )
+        )
+        return nodes, outbox
+
+    # -- messages ------------------------------------------------------------
+
+    def on_message(self, nodes: RaftState, node, src, payload, now_us, rand_u32) -> Tuple[RaftState, Outbox]:
+        mtype = payload[0]
+        branch = jnp.clip(mtype - 1, 0, 3)
+
+        def rv_branch(args):
+            nodes, = args
+            outbox = self.empty_outbox()
+            t, cand, last_idx, last_term = payload[1], payload[2], payload[3], payload[4]
+            # step down on newer term
+            newer = t > nodes.term[node]
+            nodes = update_node(
+                nodes, node,
+                term=jnp.where(newer, t, nodes.term[node]),
+                role=jnp.where(newer, FOLLOWER, nodes.role[node]),
+                voted_for=jnp.where(newer, -1, nodes.voted_for[node]),
+            )
+            my_last = nodes.log_len[node]
+            my_last_term = nodes.log_term[node, my_last]
+            log_ok = (last_term > my_last_term) | ((last_term == my_last_term) & (last_idx >= my_last))
+            can_vote = (nodes.voted_for[node] == -1) | (nodes.voted_for[node] == cand)
+            grant = (t == nodes.term[node]) & can_vote & log_ok
+            nodes = update_node(
+                nodes, node,
+                voted_for=jnp.where(grant, cand, nodes.voted_for[node]),
+                elec_deadline=jnp.where(
+                    grant, now_us + self._rand_timeout(rand_u32[0]), nodes.elec_deadline[node]
+                ),
+            )
+            vote = self._pay(M_VOTE, nodes.term[node], grant.astype(jnp.int32))
+            outbox = send_if(outbox, 0, jnp.bool_(True), src, vote)
+            return nodes, outbox
+
+        def vote_branch(args):
+            nodes, = args
+            outbox = self.empty_outbox()
+            t, granted = payload[1], payload[2]
+            newer = t > nodes.term[node]
+            nodes = update_node(
+                nodes, node,
+                term=jnp.where(newer, t, nodes.term[node]),
+                role=jnp.where(newer, FOLLOWER, nodes.role[node]),
+                voted_for=jnp.where(newer, -1, nodes.voted_for[node]),
+            )
+            counts = (t == nodes.term[node]) & (nodes.role[node] == CANDIDATE) & (granted == 1)
+            new_votes = nodes.votes[node] + jnp.where(counts, 1, 0)
+            win = counts & (new_votes >= self.majority) & (nodes.role[node] == CANDIDATE)
+            n = self.NUM_NODES
+            nodes = update_node(nodes, node, votes=new_votes, role=jnp.where(win, LEADER, nodes.role[node]))
+            # leader volatile state
+            nodes = nodes.replace(
+                next_idx=jnp.where(
+                    win,
+                    set_at(nodes.next_idx, node, jnp.full((n,), nodes.log_len[node] + 1, jnp.int32)),
+                    nodes.next_idx,
+                ),
+                match_idx=jnp.where(
+                    win,
+                    set_at(
+                        nodes.match_idx, node,
+                        set_at(jnp.zeros((n,), jnp.int32), node, nodes.log_len[node]),
+                    ),
+                    nodes.match_idx,
+                ),
+            )
+            # announce leadership immediately with heartbeats + arm timer
+            peers = self._peers(node)
+            prev_idx = nodes.log_len[node]
+            prev_term = nodes.log_term[node, prev_idx]
+            ae = self._pay(M_AE, nodes.term[node], prev_idx, prev_term, 0, nodes.commit[node])
+            for s in range(self.MAX_MSGS):
+                outbox = send_if(outbox, s, win, peers[s], ae)
+            outbox = set_timer_if(outbox, 0, win, HEARTBEAT_US, self._tid(nodes, node, T_HEARTBEAT))
+            return nodes, outbox
+
+        def ae_branch(args):
+            nodes, = args
+            outbox = self.empty_outbox()
+            t, prev_idx, prev_term, entry_term, leader_commit = (
+                payload[1], payload[2], payload[3], payload[4], payload[5],
+            )
+            stale = t < nodes.term[node]
+            newer = t > nodes.term[node]
+            nodes = update_node(
+                nodes, node,
+                term=jnp.where(newer, t, nodes.term[node]),
+                role=jnp.where(~stale, FOLLOWER, nodes.role[node]),
+                voted_for=jnp.where(newer, -1, nodes.voted_for[node]),
+                elec_deadline=jnp.where(
+                    ~stale, now_us + self._rand_timeout(rand_u32[0]), nodes.elec_deadline[node]
+                ),
+            )
+            log_ok = (prev_idx <= nodes.log_len[node]) & (nodes.log_term[node, prev_idx] == prev_term)
+            ok = ~stale & log_ok
+            has_entry = entry_term > 0
+            slot = jnp.minimum(prev_idx + 1, self.log_capacity)
+            existing_matches = (nodes.log_len[node] >= prev_idx + 1) & (
+                nodes.log_term[node, slot] == entry_term
+            )
+            append = ok & has_entry
+            new_len = jnp.where(
+                append,
+                jnp.where(existing_matches, jnp.maximum(nodes.log_len[node], prev_idx + 1), prev_idx + 1),
+                nodes.log_len[node],
+            )
+            nodes = update_node(
+                nodes, node,
+                log_term=jnp.where(
+                    append, set_at(nodes.log_term[node], slot, entry_term), nodes.log_term[node]
+                ),
+                log_len=new_len,
+                commit=jnp.where(
+                    ok,
+                    jnp.maximum(nodes.commit[node], jnp.minimum(leader_commit, new_len)),
+                    nodes.commit[node],
+                ),
+            )
+            match = jnp.where(has_entry, prev_idx + 1, prev_idx)
+            aer = self._pay(M_AER, nodes.term[node], ok.astype(jnp.int32), match)
+            outbox = send_if(outbox, 0, jnp.bool_(True), src, aer)
+            return nodes, outbox
+
+        def aer_branch(args):
+            nodes, = args
+            outbox = self.empty_outbox()
+            t, success, midx = payload[1], payload[2], payload[3]
+            newer = t > nodes.term[node]
+            nodes = update_node(
+                nodes, node,
+                term=jnp.where(newer, t, nodes.term[node]),
+                role=jnp.where(newer, FOLLOWER, nodes.role[node]),
+                voted_for=jnp.where(newer, -1, nodes.voted_for[node]),
+            )
+            is_lead = (nodes.role[node] == LEADER) & (t == nodes.term[node])
+            good = is_lead & (success == 1)
+            new_match = jnp.maximum(nodes.match_idx[node, src], midx)
+            nodes = nodes.replace(
+                match_idx=jnp.where(
+                    good, set2d(nodes.match_idx, node, src, new_match), nodes.match_idx
+                ),
+                next_idx=jnp.where(
+                    good,
+                    set2d(nodes.next_idx, node, src, new_match + 1),
+                    jnp.where(
+                        is_lead & (success == 0),
+                        set2d(
+                            nodes.next_idx, node, src,
+                            jnp.maximum(nodes.next_idx[node, src] - 1, 1),
+                        ),
+                        nodes.next_idx,
+                    ),
+                ),
+            )
+            # advance commit: highest idx replicated on a majority with
+            # an entry from the current term (Raft §5.4.2)
+            idxs = jnp.arange(self.log_capacity + 1, dtype=jnp.int32)  # [CAP+1]
+            replicated = nodes.match_idx[node][None, :] >= idxs[:, None]  # [CAP+1, N]
+            cnt = jnp.sum(replicated, axis=1)
+            cur_term_entry = nodes.log_term[node] == nodes.term[node]  # [CAP+1]
+            committable = (cnt >= self.majority) & cur_term_entry & (idxs >= 1) & (idxs <= nodes.log_len[node])
+            best = jnp.max(jnp.where(committable, idxs, 0))
+            nodes = update_node(
+                nodes, node,
+                commit=jnp.where(good, jnp.maximum(nodes.commit[node], best), nodes.commit[node]),
+            )
+            return nodes, outbox
+
+        return lax.switch(branch, [rv_branch, vote_branch, ae_branch, aer_branch], (nodes,))
+
+    # -- invariants / results ------------------------------------------------
+
+    def invariant(self, nodes: RaftState, now_us):
+        n = self.NUM_NODES
+        is_lead = nodes.role == LEADER
+        same_term = nodes.term[:, None] == nodes.term[None, :]
+        both_lead = is_lead[:, None] & is_lead[None, :] & ~jnp.eye(n, dtype=bool)
+        elec_viol = jnp.any(both_lead & same_term)
+
+        # committed prefixes must agree pairwise
+        idxs = jnp.arange(self.log_capacity + 1, dtype=jnp.int32)
+        upto = jnp.minimum(nodes.commit[:, None], nodes.commit[None, :])  # [N,N]
+        in_prefix = (idxs[None, None, :] >= 1) & (idxs[None, None, :] <= upto[:, :, None])
+        differs = nodes.log_term[:, None, :] != nodes.log_term[None, :, :]
+        log_viol = jnp.any(in_prefix & differs)
+
+        ok = ~(elec_viol | log_viol)
+        code = jnp.where(elec_viol, ELECTION_SAFETY, jnp.where(log_viol, LOG_MATCHING, 0))
+        return ok, code.astype(jnp.int32)
+
+    def is_done(self, nodes: RaftState, now_us):
+        # all nodes committed a full log => nothing left to explore
+        return jnp.all(nodes.commit >= self.log_capacity)
+
+    def summary(self, nodes: RaftState):
+        return {
+            "max_term": jnp.max(nodes.term),
+            "max_commit": jnp.max(nodes.commit),
+            "min_commit": jnp.min(nodes.commit),
+            "num_leaders": jnp.sum((nodes.role == LEADER).astype(jnp.int32)),
+        }

@@ -1,0 +1,40 @@
+"""Seed-batch parallelism over the device mesh.
+
+The scaling axis of a DST framework is *seeds*, not tensors (SURVEY.md
+§2.9): lanes are embarrassingly parallel, so sharding the lane dimension
+over a 1-D mesh axis "seeds" scales linearly over ICI (intra-slice) and
+DCN (multi-slice) with zero collectives inside the loop — only the final
+result gather crosses chips. This replaces the reference's
+one-thread-per-seed harness (madsim/src/sim/runtime/builder.rs:121-160)
+and its TCP/UCX real-mode backends (madsim/src/std/net/) as the
+distributed execution story.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SEED_AXIS = "seeds"
+
+
+def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh over all (or the given) devices, axis "seeds"."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), (SEED_AXIS,))
+
+def seed_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(SEED_AXIS))
+
+
+def shard_seeds(seeds, mesh: Mesh):
+    """Place a seed batch sharded over the mesh; the engine's whole state
+    inherits the lane sharding by propagation."""
+    return jax.device_put(seeds, seed_sharding(mesh))
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k

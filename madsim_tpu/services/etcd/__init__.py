@@ -1,0 +1,402 @@
+"""Simulated etcd v3 — client + in-sim server
+(reference: madsim-etcd-client).
+
+`SimServer` speaks a request protocol over `Endpoint.connect1`
+(reference: src/server.rs:104-167) with an injectable `timeout_rate`
+(:21-24); `Client` exposes the etcd-client surface: kv / lease /
+election / maintenance / watch, plus state `dump`/`load`
+(reference: src/sim.rs:27-78). The reference's watch API is a type stub
+(src/watch.rs:1-8); here it is fully functional (streaming put/delete
+events over a held-open connection).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+from ... import time as sim_time
+from ...errors import SimError
+from ...net import Endpoint
+from ...net.network import ConnectionReset, parse_addr
+from ...task import spawn
+from .service import EtcdError, EtcdService, Event, KeyValue, MAX_REQUEST_BYTES
+
+__all__ = [
+    "Client",
+    "SimServer",
+    "EtcdError",
+    "KeyValue",
+    "Event",
+    "Txn",
+    "Compare",
+    "TxnOp",
+]
+
+Key = Union[str, bytes]
+
+
+def _b(x: Key) -> bytes:
+    return x.encode() if isinstance(x, str) else bytes(x)
+
+
+def _prefix_end(key: bytes) -> bytes:
+    """The etcd range_end for a prefix scan: key with last byte +1."""
+    for i in reversed(range(len(key))):
+        if key[i] < 0xFF:
+            return key[:i] + bytes([key[i] + 1])
+    return b"\xff" * (len(key) + 1)
+
+
+# -- txn building blocks (reference: etcd-client Compare/Txn/TxnOp) ----------
+
+
+class Compare:
+    def __init__(self, target: str, key: Key, op: str, operand: Any):
+        self.tuple = (target, _b(key), op, operand if not isinstance(operand, (str, bytes)) else _b(operand))
+
+    @staticmethod
+    def value(key: Key, op: str, v: Key) -> "Compare":
+        return Compare("value", key, op, v)
+
+    @staticmethod
+    def version(key: Key, op: str, v: int) -> "Compare":
+        return Compare("version", key, op, v)
+
+    @staticmethod
+    def create_revision(key: Key, op: str, v: int) -> "Compare":
+        return Compare("create_revision", key, op, v)
+
+    @staticmethod
+    def mod_revision(key: Key, op: str, v: int) -> "Compare":
+        return Compare("mod_revision", key, op, v)
+
+
+class TxnOp:
+    @staticmethod
+    def put(key: Key, value: Key, lease: int = 0) -> tuple:
+        return ("put", _b(key), _b(value), lease)
+
+    @staticmethod
+    def get(key: Key, prefix: bool = False) -> tuple:
+        k = _b(key)
+        return ("get", k, _prefix_end(k) if prefix else b"")
+
+    @staticmethod
+    def delete(key: Key, prefix: bool = False) -> tuple:
+        k = _b(key)
+        return ("delete", k, _prefix_end(k) if prefix else b"")
+
+
+class Txn:
+    def __init__(self) -> None:
+        self._when: List[tuple] = []
+        self._then: List[tuple] = []
+        self._else: List[tuple] = []
+
+    def when(self, compares: Sequence[Compare]) -> "Txn":
+        self._when = [c.tuple for c in compares]
+        return self
+
+    def and_then(self, ops: Sequence[tuple]) -> "Txn":
+        self._then = list(ops)
+        return self
+
+    def or_else(self, ops: Sequence[tuple]) -> "Txn":
+        self._else = list(ops)
+        return self
+
+
+# -- server -------------------------------------------------------------------
+
+
+class SimServer:
+    """Reference: src/server.rs `SimServer` (+ sim.rs builder)."""
+
+    def __init__(self, timeout_rate: float = 0.0):
+        self.timeout_rate = timeout_rate
+        self.service: Optional[EtcdService] = None
+
+    async def serve(self, addr: Any) -> None:
+        import madsim_tpu.rand as rand
+
+        rng = rand.thread_rng()
+        self.service = EtcdService(rng)
+        ep = await Endpoint.bind(addr)
+
+        async def ticker():
+            # 1 s lease countdown (reference: service.rs:25-35)
+            it = sim_time.interval(1.0)
+            while True:
+                await it.tick()
+                self.service.tick()
+
+        spawn(ticker(), name="etcd-lease-tick")
+        while True:
+            tx, rx, peer = await ep.accept1()
+            spawn(self._handle(tx, rx), name="etcd-conn")
+
+    async def _handle(self, tx, rx) -> None:
+        import madsim_tpu.rand as rand
+
+        svc = self.service
+        rng = rand.thread_rng()
+        try:
+            req = await rx.recv()
+            if req is None:
+                return
+            if self.timeout_rate > 0 and rng.gen_bool(self.timeout_rate):
+                tx.send(("err", "etcdserver: request timed out"))
+                return
+            kind = req[0]
+            if kind == "watch":
+                await self._watch(tx, rx, req[1], req[2])
+                return
+            if kind == "observe":
+                await self._observe(tx, rx, req[1])
+                return
+            try:
+                result = self._apply(svc, req)
+                tx.send(("ok", result))
+            except EtcdError as e:
+                tx.send(("err", str(e)))
+        except ConnectionReset:
+            pass
+
+    def _apply(self, svc: EtcdService, req: tuple):
+        kind = req[0]
+        if kind == "put":
+            return svc.put(req[1], req[2], lease=req[3], prev_kv=req[4])
+        if kind == "get":
+            return svc.get(req[1], range_end=req[2], limit=req[3], count_only=req[4], keys_only=req[5])
+        if kind == "delete":
+            return svc.delete(req[1], range_end=req[2], prev_kv=req[3])
+        if kind == "txn":
+            return svc.txn(req[1], req[2], req[3])
+        if kind == "lease_grant":
+            return svc.lease_grant(req[1], req[2])
+        if kind == "lease_revoke":
+            return svc.lease_revoke(req[1])
+        if kind == "lease_keep_alive":
+            return svc.lease_keep_alive(req[1])
+        if kind == "lease_time_to_live":
+            return svc.lease_time_to_live(req[1])
+        if kind == "lease_list":
+            return svc.lease_list()
+        if kind == "campaign":
+            return svc.campaign(req[1], req[2], req[3])
+        if kind == "leader":
+            return svc.leader(req[1])
+        if kind == "proclaim":
+            return svc.proclaim(req[1], req[2])
+        if kind == "resign":
+            return svc.resign(req[1])
+        if kind == "status":
+            return svc.status()
+        if kind == "dump":
+            return svc.dump()
+        if kind == "load":
+            return svc.load(req[1])
+        raise EtcdError(f"unknown request {kind}")
+
+    async def _watch(self, tx, rx, lo: bytes, hi: bytes) -> None:
+        svc = self.service
+        entry = svc.add_watcher(lo, hi, lambda ev: self._safe_send(tx, ("event", ev), entry_box))
+        entry_box = entry
+        tx.send(("ok", {"watching": True}))
+        # hold open until the client goes away
+        while (await rx.recv()) is not None:
+            pass
+        svc.remove_watcher(entry)
+
+    def _safe_send(self, tx, msg, entry) -> None:
+        try:
+            tx.send(msg)
+        except ConnectionReset:
+            self.service.remove_watcher(entry)
+
+    async def _observe(self, tx, rx, name: bytes) -> None:
+        """Stream leadership changes (reference: election observe)."""
+        svc = self.service
+        lo, hi = svc._election_prefix(name)
+
+        def on_change(_ev: Event) -> None:
+            try:
+                info = svc.is_leader(name, b"")
+                if info["leader"] is not None:
+                    tx.send(("leader", info))
+            except ConnectionReset:
+                svc.remove_watcher(entry)
+
+        entry = svc.add_watcher(lo, hi, on_change)
+        info = svc.is_leader(name, b"")
+        tx.send(("ok", {"observing": True}))
+        if info["leader"] is not None:
+            tx.send(("leader", info))
+        while (await rx.recv()) is not None:
+            pass
+        svc.remove_watcher(entry)
+
+
+# -- client -------------------------------------------------------------------
+
+
+class Watcher:
+    """Async stream of watch events (functional, unlike the reference's
+    stub watch.rs)."""
+
+    def __init__(self, tx, rx):
+        self._tx = tx
+        self._rx = rx
+
+    def __aiter__(self) -> "Watcher":
+        return self
+
+    async def __anext__(self) -> Event:
+        msg = await self._rx.recv()
+        if msg is None:
+            raise StopAsyncIteration
+        return msg[1]
+
+    def cancel(self) -> None:
+        self._tx.close()
+
+
+class Observer:
+    def __init__(self, tx, rx):
+        self._tx = tx
+        self._rx = rx
+
+    def __aiter__(self) -> "Observer":
+        return self
+
+    async def __anext__(self) -> dict:
+        msg = await self._rx.recv()
+        if msg is None:
+            raise StopAsyncIteration
+        return msg[1]
+
+    def cancel(self) -> None:
+        self._tx.close()
+
+
+class Client:
+    """etcd-client surface (reference: src/sim.rs:27-78 `Client` with
+    kv/lease/election/maintenance sub-clients, flattened pythonically)."""
+
+    def __init__(self, addr):
+        self._addr = addr
+        self._ep: Optional[Endpoint] = None
+
+    @staticmethod
+    async def connect(endpoints: Union[str, Sequence[str]], timeout: Optional[float] = None) -> "Client":
+        if isinstance(endpoints, str):
+            endpoints = [endpoints]
+        client = Client(parse_addr(endpoints[0]))
+        client._ep = await Endpoint.bind(("0.0.0.0", 0))
+        return client
+
+    async def _call(self, req: tuple):
+        tx, rx = await self._ep.connect1(self._addr)
+        tx.send(req)
+        rsp = await rx.recv()
+        tx.close()
+        if rsp is None:
+            raise EtcdError("etcd server unavailable")
+        status, payload = rsp
+        if status == "err":
+            raise EtcdError(payload)
+        return payload
+
+    # -- kv --
+
+    async def put(self, key: Key, value: Key, lease: int = 0, prev_kv: bool = False):
+        return await self._call(("put", _b(key), _b(value), lease, prev_kv))
+
+    async def get(
+        self,
+        key: Key,
+        prefix: bool = False,
+        range_end: Optional[Key] = None,
+        limit: int = 0,
+        count_only: bool = False,
+        keys_only: bool = False,
+    ):
+        k = _b(key)
+        end = _b(range_end) if range_end is not None else (_prefix_end(k) if prefix else b"")
+        return await self._call(("get", k, end, limit, count_only, keys_only))
+
+    async def delete(self, key: Key, prefix: bool = False, prev_kv: bool = False):
+        k = _b(key)
+        end = _prefix_end(k) if prefix else b""
+        return await self._call(("delete", k, end, prev_kv))
+
+    async def txn(self, txn: Txn):
+        return await self._call(("txn", txn._when, txn._then, txn._else))
+
+    # -- lease --
+
+    async def lease_grant(self, ttl: int, lease_id: int = 0):
+        return await self._call(("lease_grant", ttl, lease_id))
+
+    async def lease_revoke(self, lease_id: int):
+        return await self._call(("lease_revoke", lease_id))
+
+    async def lease_keep_alive(self, lease_id: int):
+        return await self._call(("lease_keep_alive", lease_id))
+
+    async def lease_time_to_live(self, lease_id: int):
+        return await self._call(("lease_time_to_live", lease_id))
+
+    async def leases(self):
+        return await self._call(("lease_list",))
+
+    # -- election --
+
+    async def campaign(self, name: Key, value: Key, lease: int, poll_interval: float = 0.1):
+        """Blocks until this candidate is the leader
+        (reference: election campaign semantics)."""
+        while True:
+            info = await self._call(("campaign", _b(name), _b(value), lease))
+            if info["is_leader"]:
+                return info
+            await sim_time.sleep(poll_interval)
+
+    async def leader(self, name: Key):
+        return await self._call(("leader", _b(name)))
+
+    async def proclaim(self, value: Key, leader: dict):
+        return await self._call(("proclaim", leader["leader"], _b(value)))
+
+    async def resign(self, leader: dict):
+        return await self._call(("resign", leader["leader"]))
+
+    async def observe(self, name: Key) -> Observer:
+        tx, rx = await self._ep.connect1(self._addr)
+        tx.send(("observe", _b(name)))
+        head = await rx.recv()
+        if head is None or head[0] != "ok":
+            raise EtcdError(f"observe failed: {head}")
+        return Observer(tx, rx)
+
+    # -- watch --
+
+    async def watch(self, key: Key, prefix: bool = False) -> Watcher:
+        k = _b(key)
+        hi = _prefix_end(k) if prefix else b""
+        tx, rx = await self._ep.connect1(self._addr)
+        tx.send(("watch", k, hi))
+        head = await rx.recv()
+        if head is None or head[0] != "ok":
+            raise EtcdError(f"watch failed: {head}")
+        return Watcher(tx, rx)
+
+    # -- maintenance / persistence --
+
+    async def status(self):
+        return await self._call(("status",))
+
+    async def dump(self) -> str:
+        return await self._call(("dump",))
+
+    async def load(self, text: str):
+        return await self._call(("load", text))

@@ -1,0 +1,495 @@
+"""Simulated Kafka — broker + producer/consumer/admin clients
+(reference: madsim-rdkafka sim side, src/sim/).
+
+`Broker` keeps topics/partitions with offsets, watermarks and
+timestamp->offset lookup (reference: src/sim/broker.rs:12-60);
+`SimBroker` serves the request protocol {CreateTopic, Produce, Fetch,
+FetchMetadata, FetchWatermarks, OffsetsForTimes}
+(reference: src/sim/sim_broker.rs:14-77). Client surface:
+`ClientConfig` (string-keyed, reference: src/sim/config.rs),
+`BaseProducer` (buffered + flush + fake transactions,
+reference: src/sim/producer/base_producer.rs:154-330), `FutureProducer`
+(delivery future, future_producer.rs:191-300), `BaseConsumer` /
+`StreamConsumer` with assign/seek/poll/stream
+(reference: src/sim/consumer.rs:50-470), `AdminClient` (src/sim/admin.rs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ... import time as sim_time
+from ...errors import SimError
+from ...net import Endpoint
+from ...net.network import ConnectionReset, parse_addr
+from ...net.rpc import hash_str
+from ...task import spawn
+
+__all__ = [
+    "KafkaError",
+    "Broker",
+    "SimBroker",
+    "ClientConfig",
+    "BaseProducer",
+    "FutureProducer",
+    "BaseConsumer",
+    "StreamConsumer",
+    "AdminClient",
+    "NewTopic",
+    "Offset",
+    "Message",
+]
+
+
+class KafkaError(SimError):
+    pass
+
+
+class Message:
+    """A delivered record (reference: BorrowedMessage surface)."""
+
+    __slots__ = ("topic", "partition", "offset", "key", "payload", "timestamp")
+
+    def __init__(self, topic: str, partition: int, offset: int, key: Optional[bytes], payload: Optional[bytes], timestamp: int):
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+        self.key = key
+        self.payload = payload
+        self.timestamp = timestamp
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Message({self.topic}[{self.partition}]@{self.offset})"
+
+
+class Offset:
+    """Seek positions (reference: rdkafka Offset enum)."""
+
+    Beginning = "beginning"
+    End = "end"
+
+    @staticmethod
+    def at(n: int) -> int:
+        return n
+
+
+class NewTopic:
+    def __init__(self, name: str, num_partitions: int = 1):
+        self.name = name
+        self.num_partitions = num_partitions
+
+
+# -- broker state (reference: src/sim/broker.rs) ------------------------------
+
+
+class Partition:
+    __slots__ = ("records",)
+
+    def __init__(self) -> None:
+        # list of (key, payload, timestamp_ms); offset == index
+        self.records: List[Tuple[Optional[bytes], Optional[bytes], int]] = []
+
+    @property
+    def high_watermark(self) -> int:
+        return len(self.records)
+
+
+class Broker:
+    """Reference: broker.rs:12-60."""
+
+    def __init__(self) -> None:
+        self.topics: Dict[str, List[Partition]] = {}
+        self._rr: Dict[str, int] = {}
+
+    def create_topic(self, name: str, partitions: int) -> None:
+        if name in self.topics:
+            raise KafkaError(f"topic already exists: {name}")
+        self.topics[name] = [Partition() for _ in range(partitions)]
+        self._rr[name] = 0
+
+    def _partition(self, topic: str, partition: int) -> Partition:
+        parts = self.topics.get(topic)
+        if parts is None:
+            raise KafkaError(f"unknown topic: {topic}")
+        if not (0 <= partition < len(parts)):
+            raise KafkaError(f"unknown partition: {topic}[{partition}]")
+        return parts[partition]
+
+    def pick_partition(self, topic: str, key: Optional[bytes]) -> int:
+        parts = self.topics.get(topic)
+        if parts is None:
+            raise KafkaError(f"unknown topic: {topic}")
+        if key is not None:
+            return hash_str(key.decode("latin1")) % len(parts)
+        idx = self._rr[topic] % len(parts)
+        self._rr[topic] += 1
+        return idx
+
+    def produce(self, topic: str, partition: Optional[int], key: Optional[bytes], payload: Optional[bytes], ts_ms: int) -> Tuple[int, int]:
+        if partition is None or partition < 0:
+            partition = self.pick_partition(topic, key)
+        part = self._partition(topic, partition)
+        part.records.append((key, payload, ts_ms))
+        return partition, len(part.records) - 1
+
+    def fetch(self, topic: str, partition: int, offset: int, max_records: int) -> List[Message]:
+        part = self._partition(topic, partition)
+        out = []
+        for off in range(max(0, offset), min(len(part.records), offset + max_records)):
+            key, payload, ts = part.records[off]
+            out.append(Message(topic, partition, off, key, payload, ts))
+        return out
+
+    def watermarks(self, topic: str, partition: int) -> Tuple[int, int]:
+        part = self._partition(topic, partition)
+        return (0, part.high_watermark)
+
+    def offsets_for_time(self, topic: str, partition: int, ts_ms: int) -> Optional[int]:
+        """First offset with timestamp >= ts_ms (reference: broker.rs
+        timestamp->offset lookup)."""
+        part = self._partition(topic, partition)
+        for off, (_k, _p, ts) in enumerate(part.records):
+            if ts >= ts_ms:
+                return off
+        return None
+
+    def metadata(self) -> Dict[str, int]:
+        return {name: len(parts) for name, parts in self.topics.items()}
+
+
+# -- server --------------------------------------------------------------------
+
+
+class SimBroker:
+    """Reference: sim_broker.rs:14-77."""
+
+    def __init__(self) -> None:
+        self.broker = Broker()
+
+    async def serve(self, addr: Any) -> None:
+        ep = await Endpoint.bind(addr)
+        while True:
+            tx, rx, _peer = await ep.accept1()
+            spawn(self._handle(tx, rx), name="kafka-conn")
+
+    async def _handle(self, tx, rx) -> None:
+        b = self.broker
+        try:
+            while (req := await rx.recv()) is not None:
+                kind = req[0]
+                try:
+                    if kind == "create_topic":
+                        b.create_topic(req[1], req[2])
+                        rsp: Any = None
+                    elif kind == "produce":
+                        rsp = b.produce(req[1], req[2], req[3], req[4], req[5])
+                    elif kind == "fetch":
+                        rsp = b.fetch(req[1], req[2], req[3], req[4])
+                    elif kind == "metadata":
+                        rsp = b.metadata()
+                    elif kind == "watermarks":
+                        rsp = b.watermarks(req[1], req[2])
+                    elif kind == "offsets_for_time":
+                        rsp = b.offsets_for_time(req[1], req[2], req[3])
+                    else:
+                        raise KafkaError(f"unknown request {kind}")
+                    tx.send(("ok", rsp))
+                except KafkaError as e:
+                    tx.send(("err", str(e)))
+        except ConnectionReset:
+            pass
+
+
+# -- client config (reference: src/sim/config.rs) -------------------------------
+
+
+class ClientConfig:
+    def __init__(self, conf: Optional[Dict[str, str]] = None):
+        self.conf: Dict[str, str] = dict(conf or {})
+
+    def set(self, key: str, value: str) -> "ClientConfig":
+        self.conf[key] = value
+        return self
+
+    def get(self, key: str, default: str = "") -> str:
+        return self.conf.get(key, default)
+
+    def _addr(self):
+        servers = self.conf.get("bootstrap.servers")
+        if not servers:
+            raise KafkaError("bootstrap.servers not set")
+        return parse_addr(servers.split(",")[0])
+
+    async def create_base_producer(self) -> "BaseProducer":
+        return await BaseProducer._create(self)
+
+    async def create_future_producer(self) -> "FutureProducer":
+        p = FutureProducer()
+        p._inner = await BaseProducer._create(self)
+        return p
+
+    async def create_base_consumer(self) -> "BaseConsumer":
+        return await BaseConsumer._create(self)
+
+    async def create_stream_consumer(self) -> "StreamConsumer":
+        c = StreamConsumer()
+        c.__dict__.update((await BaseConsumer._create(self)).__dict__)
+        return c
+
+    async def create_admin(self) -> "AdminClient":
+        return await AdminClient._create(self)
+
+
+class _Conn:
+    """Broker connection handle. Each call opens its own connect1 stream,
+    so a timed-out/aborted call abandons only its own channel — no
+    request/response correlation needed and concurrent DeliveryFutures
+    cannot desynchronize responses."""
+
+    def __init__(self) -> None:
+        self._ep = None
+        self._addr = None
+
+    async def open(self, addr) -> None:
+        self._ep = await Endpoint.bind(("0.0.0.0", 0))
+        self._addr = addr
+
+    async def call(self, req: tuple):
+        tx, rx = await self._ep.connect1(self._addr)
+        try:
+            tx.send(req)
+            rsp = await rx.recv()
+        finally:
+            tx.close()
+        if rsp is None:
+            raise KafkaError("broker unavailable")
+        status, payload = rsp
+        if status == "err":
+            raise KafkaError(payload)
+        return payload
+
+
+# -- producers -------------------------------------------------------------------
+
+
+class BaseRecord:
+    """Reference: rdkafka BaseRecord/FutureRecord."""
+
+    def __init__(self, topic: str, key: Optional[bytes] = None, payload: Optional[bytes] = None, partition: Optional[int] = None, timestamp: Optional[int] = None):
+        self.topic = topic
+        self.key = key
+        self.payload = payload
+        self.partition = partition
+        self.timestamp = timestamp
+
+
+FutureRecord = BaseRecord
+
+
+class BaseProducer:
+    """Buffered producer: `send` queues locally, `flush` ships to the
+    broker; fake transactions are buffer fences
+    (reference: base_producer.rs:154-330)."""
+
+    def __init__(self) -> None:
+        self._conn = _Conn()
+        self._buffer: List[BaseRecord] = []
+        self._in_txn = False
+
+    @staticmethod
+    async def _create(cfg: ClientConfig) -> "BaseProducer":
+        p = BaseProducer()
+        await p._conn.open(cfg._addr())
+        return p
+
+    def send(self, record: BaseRecord) -> None:
+        self._buffer.append(record)
+
+    async def flush(self) -> List[Tuple[int, int]]:
+        out = []
+        buffered, self._buffer = self._buffer, []
+        for r in buffered:
+            ts = r.timestamp if r.timestamp is not None else int(sim_time.now() * 1000)
+            out.append(await self._conn.call(("produce", r.topic, r.partition, r.key, r.payload, ts)))
+        return out
+
+    # fake transactions (reference: base_producer.rs transactions are
+    # acknowledged but not isolated)
+    def init_transactions(self) -> None:
+        pass
+
+    def begin_transaction(self) -> None:
+        if self._in_txn:
+            raise KafkaError("transaction already in progress")
+        self._in_txn = True
+
+    async def commit_transaction(self) -> None:
+        if not self._in_txn:
+            raise KafkaError("no transaction in progress")
+        await self.flush()
+        self._in_txn = False
+
+    def abort_transaction(self) -> None:
+        self._buffer.clear()
+        self._in_txn = False
+
+
+class DeliveryFuture:
+    """Reference: future_producer.rs `DeliveryFuture`.
+
+    Delivery errors (timeouts, broker unreachable) surface to the
+    awaiter, not as a simulation-aborting task panic."""
+
+    def __init__(self, coro):
+        from ...task import spawn
+
+        async def captured():
+            try:
+                return ("ok", await coro)
+            except Exception as exc:  # noqa: BLE001
+                return ("err", exc)
+
+        self._handle = spawn(captured(), name="kafka-delivery")
+
+    def __await__(self):
+        return self._await().__await__()
+
+    async def _await(self):
+        status, value = await self._handle
+        if status == "err":
+            raise value
+        return value
+
+
+class FutureProducer:
+    """Reference: future_producer.rs:191-300."""
+
+    def __init__(self) -> None:
+        self._inner: Optional[BaseProducer] = None
+
+    def send(self, record: BaseRecord, timeout: Optional[float] = None) -> DeliveryFuture:
+        async def deliver():
+            ts = record.timestamp if record.timestamp is not None else int(sim_time.now() * 1000)
+            call = self._inner._conn.call(("produce", record.topic, record.partition, record.key, record.payload, ts))
+            if timeout is not None:
+                return await sim_time.timeout(timeout, call)
+            return await call
+
+        return DeliveryFuture(deliver())
+
+    async def send_and_wait(self, record: BaseRecord, timeout: Optional[float] = None) -> Tuple[int, int]:
+        return await self.send(record, timeout)
+
+
+# -- consumers --------------------------------------------------------------------
+
+
+class BaseConsumer:
+    """Manual-assignment consumer (reference: consumer.rs:50-470)."""
+
+    def __init__(self) -> None:
+        self._conn = _Conn()
+        # (topic, partition) -> next offset
+        self._positions: Dict[Tuple[str, int], int] = {}
+        self._poll_interval = 0.01
+
+    @staticmethod
+    async def _create(cfg: ClientConfig) -> "BaseConsumer":
+        c = BaseConsumer()
+        await c._conn.open(cfg._addr())
+        c._auto_reset = cfg.get("auto.offset.reset", "earliest")
+        return c
+
+    async def subscribe(self, topics: Sequence[str]) -> None:
+        """Assign all partitions of the topics (the sim has no consumer
+        groups, like the reference's manual-assign model)."""
+        meta = await self._conn.call(("metadata",))
+        for t in topics:
+            if t not in meta:
+                raise KafkaError(f"unknown topic: {t}")
+            for partid in range(meta[t]):
+                await self.assign(t, partid, Offset.Beginning if self._auto_reset == "earliest" else Offset.End)
+
+    async def assign(self, topic: str, partition: int, offset: Union[str, int] = Offset.Beginning) -> None:
+        lo, hi = await self._conn.call(("watermarks", topic, partition))
+        if offset == Offset.Beginning:
+            pos = lo
+        elif offset == Offset.End:
+            pos = hi
+        else:
+            pos = int(offset)
+        self._positions[(topic, partition)] = pos
+
+    async def seek(self, topic: str, partition: int, offset: Union[str, int]) -> None:
+        if (topic, partition) not in self._positions:
+            raise KafkaError(f"not assigned: {topic}[{partition}]")
+        await self.assign(topic, partition, offset)
+
+    async def poll(self, timeout: Optional[float] = None) -> Optional[Message]:
+        """Next message across assigned partitions, or None on timeout."""
+        deadline = sim_time.now() + timeout if timeout is not None else None
+        while True:
+            for (topic, part), pos in sorted(self._positions.items()):
+                msgs = await self._conn.call(("fetch", topic, part, pos, 1))
+                if msgs:
+                    self._positions[(topic, part)] = msgs[0].offset + 1
+                    return msgs[0]
+            if deadline is not None and sim_time.now() >= deadline:
+                return None
+            await sim_time.sleep(self._poll_interval)
+
+    async def fetch_watermarks(self, topic: str, partition: int) -> Tuple[int, int]:
+        return tuple(await self._conn.call(("watermarks", topic, partition)))
+
+    async def offsets_for_timestamp(self, topic: str, partition: int, ts_ms: int) -> Optional[int]:
+        return await self._conn.call(("offsets_for_time", topic, partition, ts_ms))
+
+    async def fetch_metadata(self) -> Dict[str, int]:
+        return await self._conn.call(("metadata",))
+
+
+class StreamConsumer(BaseConsumer):
+    """Reference: consumer.rs `StreamConsumer` (async recv/stream)."""
+
+    async def recv(self) -> Message:
+        msg = await self.poll(timeout=None)
+        assert msg is not None
+        return msg
+
+    def stream(self):
+        return self
+
+    def __aiter__(self) -> "StreamConsumer":
+        return self
+
+    async def __anext__(self) -> Message:
+        return await self.recv()
+
+
+# -- admin -----------------------------------------------------------------------
+
+
+class AdminClient:
+    """Reference: src/sim/admin.rs."""
+
+    def __init__(self) -> None:
+        self._conn = _Conn()
+
+    @staticmethod
+    async def _create(cfg: ClientConfig) -> "AdminClient":
+        a = AdminClient()
+        await a._conn.open(cfg._addr())
+        return a
+
+    async def create_topics(self, topics: Sequence[NewTopic]) -> List[Tuple[str, Optional[str]]]:
+        """Per-topic results, rdkafka-style: (name, None) on success or
+        (name, error_string) — creating an existing topic is not fatal
+        (reference: admin.rs TopicResult semantics)."""
+        results: List[Tuple[str, Optional[str]]] = []
+        for t in topics:
+            try:
+                await self._conn.call(("create_topic", t.name, t.num_partitions))
+                results.append((t.name, None))
+            except KafkaError as e:
+                results.append((t.name, str(e)))
+        return results

@@ -1,0 +1,275 @@
+"""Simulated S3 — aws-sdk-style fluent client + in-sim server
+(reference: madsim-aws-sdk-s3).
+
+`S3Service` is a sorted-map object store with multipart-upload state and
+per-bucket lifecycle configuration (reference: src/server/service.rs:27-60+);
+`SimServer` serves the request enum over `Endpoint.connect1`
+(reference: src/server/rpc_server.rs:22-65); the client exposes fluent
+builders (`client.put_object().bucket(b).key(k).body(data).send()`)
+mirroring the aws-sdk surface (reference: src/client.rs, src/operation/*).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from ... import time as sim_time
+from ...errors import SimError
+from ...net import Endpoint
+from ...net.network import ConnectionReset, parse_addr
+from ...task import spawn
+
+__all__ = ["S3Error", "S3Service", "SimServer", "Client", "Config"]
+
+
+class S3Error(SimError):
+    def __init__(self, code: str, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+
+
+class _Object:
+    __slots__ = ("body", "last_modified", "etag")
+
+    def __init__(self, body: bytes, last_modified: float):
+        self.body = body
+        self.last_modified = last_modified
+        self.etag = hashlib.md5(body).hexdigest()
+
+
+class S3Service:
+    """Reference: src/server/service.rs `S3Service`."""
+
+    def __init__(self, rng):
+        self.rng = rng
+        self.buckets: Dict[str, Dict[str, _Object]] = {}
+        # upload_id -> (bucket, key, {part_number: bytes})
+        self.uploads: Dict[str, Tuple[str, str, Dict[int, bytes]]] = {}
+        self.lifecycle: Dict[str, dict] = {}
+
+    def _bucket(self, name: str) -> Dict[str, _Object]:
+        if name not in self.buckets:
+            raise S3Error("NoSuchBucket", name)
+        return self.buckets[name]
+
+    # -- operations (the request enum) --
+
+    def create_bucket(self, bucket: str) -> dict:
+        if bucket in self.buckets:
+            raise S3Error("BucketAlreadyExists", bucket)
+        self.buckets[bucket] = {}
+        return {"location": f"/{bucket}"}
+
+    def delete_bucket(self, bucket: str) -> dict:
+        if self._bucket(bucket):
+            raise S3Error("BucketNotEmpty", bucket)
+        del self.buckets[bucket]
+        return {}
+
+    def put_object(self, bucket: str, key: str, body: bytes, now: float) -> dict:
+        b = self._bucket(bucket)
+        obj = _Object(bytes(body), now)
+        b[key] = obj
+        return {"e_tag": obj.etag}
+
+    def get_object(self, bucket: str, key: str) -> dict:
+        b = self._bucket(bucket)
+        if key not in b:
+            raise S3Error("NoSuchKey", key)
+        obj = b[key]
+        return {"body": obj.body, "e_tag": obj.etag, "last_modified": obj.last_modified,
+                "content_length": len(obj.body)}
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        info = self.get_object(bucket, key)
+        info.pop("body")
+        return info
+
+    def copy_object(self, src_bucket: str, src_key: str, bucket: str, key: str, now: float) -> dict:
+        src = self.get_object(src_bucket, src_key)
+        return self.put_object(bucket, key, src["body"], now)
+
+    def delete_object(self, bucket: str, key: str) -> dict:
+        self._bucket(bucket).pop(key, None)
+        return {}
+
+    def delete_objects(self, bucket: str, keys: List[str]) -> dict:
+        b = self._bucket(bucket)
+        deleted = [k for k in keys if b.pop(k, None) is not None]
+        return {"deleted": deleted}
+
+    def list_objects_v2(self, bucket: str, prefix: str = "", continuation: Optional[str] = None, max_keys: int = 1000) -> dict:
+        b = self._bucket(bucket)
+        keys = sorted(k for k in b if k.startswith(prefix or ""))
+        if continuation:
+            keys = [k for k in keys if k > continuation]
+        page = keys[:max_keys]
+        truncated = len(keys) > len(page)
+        return {
+            "contents": [
+                {"key": k, "size": len(b[k].body), "e_tag": b[k].etag, "last_modified": b[k].last_modified}
+                for k in page
+            ],
+            "is_truncated": truncated,
+            "next_continuation_token": page[-1] if truncated and page else None,
+            "key_count": len(page),
+        }
+
+    # -- multipart (reference: src/operation/{create,upload,complete,abort}_*) --
+
+    def create_multipart_upload(self, bucket: str, key: str) -> dict:
+        self._bucket(bucket)
+        upload_id = format(self.rng.next_u64(), "032x")
+        self.uploads[upload_id] = (bucket, key, {})
+        return {"upload_id": upload_id}
+
+    def upload_part(self, upload_id: str, part_number: int, body: bytes) -> dict:
+        if upload_id not in self.uploads:
+            raise S3Error("NoSuchUpload", upload_id)
+        if part_number < 1 or part_number > 10_000:
+            raise S3Error("InvalidArgument", "part number out of range")
+        self.uploads[upload_id][2][part_number] = bytes(body)
+        return {"e_tag": hashlib.md5(bytes(body)).hexdigest()}
+
+    def complete_multipart_upload(self, upload_id: str, now: float) -> dict:
+        if upload_id not in self.uploads:
+            raise S3Error("NoSuchUpload", upload_id)
+        bucket, key, parts = self.uploads.pop(upload_id)
+        body = b"".join(parts[n] for n in sorted(parts))
+        return self.put_object(bucket, key, body, now)
+
+    def abort_multipart_upload(self, upload_id: str) -> dict:
+        if upload_id not in self.uploads:
+            raise S3Error("NoSuchUpload", upload_id)
+        del self.uploads[upload_id]
+        return {}
+
+    # -- lifecycle (reference: service.rs lifecycle config) --
+
+    def put_bucket_lifecycle_configuration(self, bucket: str, config: dict) -> dict:
+        self._bucket(bucket)
+        self.lifecycle[bucket] = config
+        return {}
+
+    def get_bucket_lifecycle_configuration(self, bucket: str) -> dict:
+        self._bucket(bucket)
+        return self.lifecycle.get(bucket, {"rules": []})
+
+
+class SimServer:
+    """Reference: src/server/rpc_server.rs `SimServer`."""
+
+    def __init__(self) -> None:
+        self.service: Optional[S3Service] = None
+
+    async def serve(self, addr: Any) -> None:
+        import madsim_tpu.rand as rand
+
+        self.service = S3Service(rand.thread_rng())
+        ep = await Endpoint.bind(addr)
+        while True:
+            tx, rx, _peer = await ep.accept1()
+            spawn(self._handle(tx, rx), name="s3-conn")
+
+    async def _handle(self, tx, rx) -> None:
+        svc = self.service
+        try:
+            while (req := await rx.recv()) is not None:
+                op, params = req
+                try:
+                    fn = getattr(svc, op, None)
+                    if fn is None:
+                        raise S3Error("NotImplemented", op)
+                    if op in ("put_object", "copy_object", "complete_multipart_upload"):
+                        params = {**params, "now": sim_time.now()}
+                    tx.send(("ok", fn(**params)))
+                except S3Error as e:
+                    tx.send(("err", (e.code, e.message)))
+        except ConnectionReset:
+            pass
+
+
+# -- client --------------------------------------------------------------------
+
+
+class Config:
+    """Reference: src/config.rs (endpoint_url is the only knob that
+    matters in-sim)."""
+
+    def __init__(self, endpoint_url: str):
+        self.endpoint_url = endpoint_url
+
+
+class _FluentOp:
+    """aws-sdk fluent builder: unknown attribute calls set parameters,
+    `.send()` performs the request (reference: src/operation/*.rs)."""
+
+    def __init__(self, client: "Client", op: str):
+        self._client = client
+        self._op = op
+        self._params: Dict[str, Any] = {}
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def setter(value: Any) -> "_FluentOp":
+            self._params[name] = value
+            return self
+
+        return setter
+
+    async def send(self):
+        return await self._client._call(self._op, self._params)
+
+
+class Client:
+    """Reference: src/client.rs `Client::from_conf`."""
+
+    _OPS = [
+        "create_bucket",
+        "delete_bucket",
+        "put_object",
+        "get_object",
+        "head_object",
+        "copy_object",
+        "delete_object",
+        "delete_objects",
+        "list_objects_v2",
+        "create_multipart_upload",
+        "upload_part",
+        "complete_multipart_upload",
+        "abort_multipart_upload",
+        "put_bucket_lifecycle_configuration",
+        "get_bucket_lifecycle_configuration",
+    ]
+
+    def __init__(self, config: Config):
+        self._addr = parse_addr(config.endpoint_url.replace("http://", ""))
+        self._ep: Optional[Endpoint] = None
+
+    @staticmethod
+    def from_conf(config: Config) -> "Client":
+        return Client(config)
+
+    def __getattr__(self, name: str):
+        if name in Client._OPS:
+            return lambda: _FluentOp(self, name)
+        raise AttributeError(name)
+
+    async def _call(self, op: str, params: Dict[str, Any]):
+        if self._ep is None:
+            self._ep = await Endpoint.bind(("0.0.0.0", 0))
+        tx, rx = await self._ep.connect1(self._addr)
+        tx.send((op, params))
+        rsp = await rx.recv()
+        tx.close()
+        if rsp is None:
+            raise S3Error("ServiceUnavailable", "s3 server unreachable")
+        status, payload = rsp
+        if status == "err":
+            code, msg = payload
+            raise S3Error(code, msg)
+        return payload

@@ -1,14 +1,14 @@
-"""Test config: force JAX onto a virtual 8-device CPU mesh.
+"""Test config: make an 8-device virtual CPU mesh available.
 
-Multi-chip TPU hardware is not available in CI; the TPU engine's sharding
-is validated on `--xla_force_host_platform_device_count=8` CPU devices
-(the driver separately dry-run-compiles the multi-chip path via
-`__graft_entry__.dryrun_multichip`). Must run before jax is imported.
+This environment's default JAX backend may be a single tunneled TPU chip
+(platform "axon"); the CPU backend coexists and honors
+--xla_force_host_platform_device_count, so multi-chip sharding tests
+build their mesh from jax.devices("cpu") explicitly. Must run before jax
+is imported.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -223,3 +223,68 @@ def test_broadcast():
         return [await rx1.recv(), await rx1.recv(), await rx2.recv(), await rx2.recv()]
 
     assert run(main) == ["a", "b", "a", "b"]
+
+
+def test_select_and_joinset():
+    from madsim_tpu import tokio
+    from madsim_tpu.select import select
+
+    async def main():
+        async def fast():
+            await sim_time.sleep(1.0)
+            return "fast"
+
+        async def slow():
+            await sim_time.sleep(5.0)
+            return "slow"
+
+        idx, value = await select(slow(), fast())
+        assert (idx, value) == (1, "fast")
+
+        js = tokio.JoinSet()
+        for d, tag in ((3.0, "c"), (1.0, "a"), (2.0, "b")):
+            async def job(d=d, tag=tag):
+                await sim_time.sleep(d)
+                return tag
+            js.spawn(job())
+        order = [await js.join_next() for _ in range(3)]
+        assert order == ["a", "b", "c"]
+        assert await js.join_next() is None
+
+        # fake runtime forwards spawn, refuses block_on
+        rt = tokio.runtime.Builder.new_multi_thread().enable_all().build()
+        h = rt.spawn(fast())
+        assert await h == "fast"
+        with pytest.raises(NotImplementedError):
+            rt.block_on(fast())
+        return True
+
+    assert run(main)
+
+
+def test_joinset_failed_task_does_not_poison():
+    from madsim_tpu import tokio
+
+    async def main():
+        js = tokio.JoinSet()
+
+        async def bad():
+            raise ValueError("task failed")
+
+        async def good():
+            await sim_time.sleep(1.0)
+            return "good"
+
+        # note: unhandled task panics normally abort the sim; JoinSet holds
+        # the handle, so the panic is routed to join_next instead
+        js.spawn(good())
+        results = []
+        errors = []
+        for _ in range(1):
+            try:
+                results.append(await js.join_next())
+            except ValueError as e:
+                errors.append(str(e))
+        return results
+
+    assert run(main) == ["good"]
