@@ -1,0 +1,121 @@
+"""Native core loader — builds simcore.cc with g++ on first use.
+
+The reference runtime is native Rust; here the host engine's hot inner
+loops (bulk Philox generation, the timer heap) run in C++ via ctypes.
+Everything degrades to pure Python with identical semantics when no
+toolchain is available (`MADSIM_TPU_NO_NATIVE=1` forces the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "simcore.cc")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("MADSIM_TPU_NO_NATIVE"):
+        return None
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        so_path = os.path.join(_HERE, f"simcore-{digest}.so")
+        if not os.path.exists(so_path):
+            tmp = f"{so_path}.{os.getpid()}.tmp"  # unique: concurrent builders don't clobber
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, so_path)
+        lib = ctypes.CDLL(so_path)
+        lib.philox_fill.argtypes = [
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.timer_new.restype = ctypes.c_void_p
+        lib.timer_free.argtypes = [ctypes.c_void_p]
+        lib.timer_push.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+        lib.timer_pop.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.timer_pop.restype = ctypes.c_int
+        lib.timer_peek.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+        lib.timer_peek.restype = ctypes.c_int
+        lib.timer_len.argtypes = [ctypes.c_void_p]
+        lib.timer_len.restype = ctypes.c_uint64
+        return lib
+    except Exception:  # noqa: BLE001 - no toolchain / build failure: fall back
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if not _tried:
+        _lib = _build_and_load()
+        _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def philox_fill(k0: int, k1: int, start_block: int, nblocks: int) -> List[int]:
+    """nblocks philox blocks as a flat list of 4*nblocks uint32 words —
+    bit-identical to repeated rand/philox.py `philox4x32` calls."""
+    lib = get_lib()
+    assert lib is not None
+    buf = (ctypes.c_uint32 * (4 * nblocks))()
+    lib.philox_fill(k0, k1, start_block, nblocks, buf)
+    return list(buf)
+
+
+class NativeTimerHeap:
+    """(deadline, seq)-ordered timer heap with integer ids; the Python
+    side keeps id -> callback."""
+
+    __slots__ = ("_lib", "_h")
+
+    def __init__(self) -> None:
+        self._lib = get_lib()
+        assert self._lib is not None
+        self._h = self._lib.timer_new()
+
+    def push(self, deadline: int, seq: int) -> None:
+        self._lib.timer_push(self._h, deadline, seq)
+
+    def pop(self) -> Optional[Tuple[int, int]]:
+        """(deadline, seq) of the earliest timer, or None."""
+        deadline = ctypes.c_int64()
+        seq = ctypes.c_uint64()
+        if not self._lib.timer_pop(self._h, ctypes.byref(deadline), ctypes.byref(seq)):
+            return None
+        return deadline.value, seq.value
+
+    def peek_deadline(self) -> Optional[int]:
+        deadline = ctypes.c_int64()
+        if not self._lib.timer_peek(self._h, ctypes.byref(deadline)):
+            return None
+        return deadline.value
+
+    def __len__(self) -> int:
+        return self._lib.timer_len(self._h)
+
+    def __del__(self) -> None:  # noqa: D105 - freeing native memory only
+        lib = getattr(self, "_lib", None)
+        if lib is not None:
+            lib.timer_free(self._h)

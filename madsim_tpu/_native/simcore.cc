@@ -1,0 +1,112 @@
+// simcore — native hot-path core for the host engine.
+//
+// The reference's runtime is native Rust end-to-end; the Python host
+// engine keeps its hot inner loops native via this small C++ core:
+//   * bulk Philox4x32-10 block generation (same constants/recurrence as
+//     madsim_tpu/rand/philox.py — bit-identical output, asserted in
+//     tests/test_native.py)
+//   * the timer event-queue as a binary heap ordered by (deadline, seq),
+//     exactly the ordering of the Python heapq path
+// Built with g++ at first import (see __init__.py); the framework falls
+// back to pure Python when no toolchain is available, with identical
+// semantics either way.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+constexpr uint32_t kPhiloxM0 = 0xD2511F53u;
+constexpr uint32_t kPhiloxM1 = 0xCD9E8D57u;
+constexpr uint32_t kPhiloxW0 = 0x9E3779B9u;
+constexpr uint32_t kPhiloxW1 = 0xBB67AE85u;
+
+inline void philox_block(uint32_t k0, uint32_t k1, uint32_t c0, uint32_t c1,
+                         uint32_t c2, uint32_t c3, uint32_t* out) {
+  for (int round = 0; round < 10; ++round) {
+    uint64_t p0 = static_cast<uint64_t>(kPhiloxM0) * c0;
+    uint64_t p1 = static_cast<uint64_t>(kPhiloxM1) * c2;
+    uint32_t hi0 = static_cast<uint32_t>(p0 >> 32);
+    uint32_t lo0 = static_cast<uint32_t>(p0);
+    uint32_t hi1 = static_cast<uint32_t>(p1 >> 32);
+    uint32_t lo1 = static_cast<uint32_t>(p1);
+    uint32_t n0 = hi1 ^ c1 ^ k0;
+    uint32_t n1 = lo1;
+    uint32_t n2 = hi0 ^ c3 ^ k1;
+    uint32_t n3 = lo0;
+    c0 = n0; c1 = n1; c2 = n2; c3 = n3;
+    k0 += kPhiloxW0;
+    k1 += kPhiloxW1;
+  }
+  out[0] = c0; out[1] = c1; out[2] = c2; out[3] = c3;
+}
+
+struct TimerEntry {
+  int64_t deadline;
+  uint64_t seq;  // unique insertion number: FIFO tie-break AND callback key
+};
+
+struct TimerCmp {
+  // std::push_heap is a max-heap; invert for earliest-(deadline, seq) first.
+  bool operator()(const TimerEntry& a, const TimerEntry& b) const {
+    if (a.deadline != b.deadline) return a.deadline > b.deadline;
+    return a.seq > b.seq;
+  }
+};
+
+struct TimerHeap {
+  std::vector<TimerEntry> entries;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Fill out[0 .. 4*nblocks) with philox blocks start_block .. start_block+nblocks.
+// Counter layout matches rand/philox.py: (block & 0xffffffff, block >> 32, 0, 0).
+void philox_fill(uint32_t k0, uint32_t k1, uint64_t start_block,
+                 uint64_t nblocks, uint32_t* out) {
+  for (uint64_t i = 0; i < nblocks; ++i) {
+    uint64_t block = start_block + i;
+    philox_block(k0, k1, static_cast<uint32_t>(block),
+                 static_cast<uint32_t>(block >> 32), 0u, 0u, out + 4 * i);
+  }
+}
+
+void* timer_new() { return new TimerHeap(); }
+
+void timer_free(void* h) { delete static_cast<TimerHeap*>(h); }
+
+void timer_push(void* h, int64_t deadline, uint64_t seq) {
+  auto* heap = static_cast<TimerHeap*>(h);
+  heap->entries.push_back(TimerEntry{deadline, seq});
+  std::push_heap(heap->entries.begin(), heap->entries.end(), TimerCmp{});
+}
+
+// Pop the earliest timer; returns 0 when empty.
+int timer_pop(void* h, int64_t* deadline, uint64_t* seq) {
+  auto* heap = static_cast<TimerHeap*>(h);
+  if (heap->entries.empty()) return 0;
+  std::pop_heap(heap->entries.begin(), heap->entries.end(), TimerCmp{});
+  TimerEntry e = heap->entries.back();
+  heap->entries.pop_back();
+  *deadline = e.deadline;
+  *seq = e.seq;
+  return 1;
+}
+
+// Peek the earliest deadline; returns 0 when empty.
+int timer_peek(void* h, int64_t* deadline) {
+  auto* heap = static_cast<TimerHeap*>(h);
+  if (heap->entries.empty()) return 0;
+  *deadline = heap->entries.front().deadline;
+  return 1;
+}
+
+uint64_t timer_len(void* h) {
+  return static_cast<TimerHeap*>(h)->entries.size();
+}
+
+}  // extern "C"

@@ -54,14 +54,29 @@ class GlobalRng:
         self._draw_index = 0
         # buggify state (reference: sim/buggify.rs + sim/rand.rs:119-135)
         self.buggify_enabled = False
+        from .. import _native
+
+        self._native_fill = _native.philox_fill if _native.available() else None
 
     # -- core draws ---------------------------------------------------------
 
+    _NATIVE_REFILL_BLOCKS = 64  # 256 words per native call
+
     def _refill(self) -> None:
+        """Refill the word buffer; bulk-generates via the C++ core when
+        available (resolved once in __init__). The word *sequence* is
+        identical either way (blocks are consumed in counter order), so
+        native/pure runs are bit-identical."""
         c = self._counter
-        self._counter += 1
-        words = philox4x32(self._key, (c & 0xFFFFFFFF, (c >> 32) & 0xFFFFFFFF, 0, 0))
-        self._buf = list(words)
+        if self._native_fill is not None:
+            n = self._NATIVE_REFILL_BLOCKS
+            self._buf = self._native_fill(self._key[0], self._key[1], c, n)
+            self._counter += n
+        else:
+            self._buf = list(
+                philox4x32(self._key, (c & 0xFFFFFFFF, (c >> 32) & 0xFFFFFFFF, 0, 0))
+            )
+            self._counter += 1
         self._buf_pos = 0
 
     def next_u32(self) -> int:

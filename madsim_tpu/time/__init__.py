@@ -73,6 +73,12 @@ class TimeHandle:
         self._now_ns = 0
         self._heap: List[Tuple[int, int, Callable[[], None]]] = []
         self._seq = 0  # FIFO tie-break for equal deadlines (deterministic)
+        # Native timer heap (C++ core) when available; same
+        # (deadline, seq) ordering as the heapq fallback.
+        from .. import _native
+
+        self._native_heap = _native.NativeTimerHeap() if _native.available() else None
+        self._callbacks: dict = {}
         # Random base wall clock ~year 2022 + up to one year of offset
         # (reference: sim/time/mod.rs:26-31).
         self.base_system_ns = _JAN_2022_NS + rng.gen_range(0, 365 * 24 * 3600) * SEC
@@ -96,9 +102,15 @@ class TimeHandle:
 
     def add_timer_ns(self, deadline_ns: int, callback: Callable[[], None]) -> None:
         self._seq += 1
-        heapq.heappush(self._heap, (deadline_ns, self._seq, callback))
+        if self._native_heap is not None:
+            self._callbacks[self._seq] = callback
+            self._native_heap.push(deadline_ns, self._seq)
+        else:
+            heapq.heappush(self._heap, (deadline_ns, self._seq, callback))
 
     def next_event_ns(self) -> Optional[int]:
+        if self._native_heap is not None:
+            return self._native_heap.peek_deadline()
         return self._heap[0][0] if self._heap else None
 
     def advance_to_next_event(self) -> bool:
@@ -107,9 +119,16 @@ class TimeHandle:
         Returns False when no timer is pending (deadlock, unless the main
         future completed). Reference: sim/time/mod.rs:45-59.
         """
-        if not self._heap:
-            return False
-        deadline, _seq, callback = heapq.heappop(self._heap)
+        if self._native_heap is not None:
+            popped = self._native_heap.pop()
+            if popped is None:
+                return False
+            deadline, seq = popped
+            callback = self._callbacks.pop(seq)
+        else:
+            if not self._heap:
+                return False
+            deadline, _seq, callback = heapq.heappop(self._heap)
         if deadline > self._now_ns:
             self._now_ns = deadline
         callback()
