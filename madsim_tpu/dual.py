@@ -1,0 +1,31 @@
+"""Dual-build facade — the Python equivalent of `#[cfg(madsim)]`.
+
+The reference's backbone pattern: every public crate re-exports either
+the real implementation or the sim one depending on the `madsim` cfg
+flag (reference: madsim/src/lib.rs:15-23, madsim-tokio/src/lib.rs:1-8).
+Python selects at import time instead:
+
+    # app.py — identical code for test and production
+    from madsim_tpu.dual import net
+    ep = await net.Endpoint.bind("0.0.0.0:500")
+
+    MADSIM_TPU_MODE=sim  (default) -> simulated fabric, needs a Runtime
+    MADSIM_TPU_MODE=real           -> asyncio TCP, runs anywhere
+"""
+
+from __future__ import annotations
+
+import os
+
+MODE = os.environ.get("MADSIM_TPU_MODE", "sim")
+
+if MODE == "real":
+    from . import real as net  # noqa: F401  (real.Endpoint)
+
+    IS_SIM = False
+else:
+    from . import net  # noqa: F401  (sim Endpoint + fabric)
+
+    IS_SIM = True
+
+__all__ = ["net", "MODE", "IS_SIM"]

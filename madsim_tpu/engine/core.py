@@ -431,6 +431,33 @@ class Engine:
         (the only device->host traffic besides summaries)."""
         return result.seeds[result.failed]
 
+    def check_determinism(self, seeds: jax.Array, max_steps: int = 10_000) -> BatchResult:
+        """Run the batch twice and require exactly equal results — the
+        engine-side analogue of `Runtime.check_determinism`
+        (reference: sim/runtime/mod.rs:178-203). Catches machines that
+        smuggle nondeterminism past the tracer (e.g. host callbacks or
+        trace-time Python state)."""
+        from ..errors import NonDeterminism
+
+        # Two independent jit wrappers => two traces, so trace-time Python
+        # nondeterminism (mutable counters, random.choice in handlers) is
+        # caught, not just per-execution effects.
+        r1 = jax.jit(partial(self.run_batch, max_steps=max_steps))(seeds)
+        r2 = jax.jit(partial(self.run_batch, max_steps=max_steps))(seeds)
+        flat1 = jax.tree_util.tree_flatten_with_path(r1)[0]
+        flat2 = jax.tree.leaves(r2)
+        mismatches = [
+            jax.tree_util.keystr(path)
+            for (path, a), b in zip(flat1, flat2)
+            if not bool((a == b).all())
+        ]
+        if mismatches:
+            raise NonDeterminism(
+                f"TPU engine produced different results for identical seed "
+                f"batches; diverging leaves: {mismatches}"
+            )
+        return r1
+
 
 def _push(eq, idx, do_push, time, seq, kind, node, src, payload):
     """Masked-select write of one event into slot `idx` (no scatters)."""

@@ -4,8 +4,10 @@
 reference: tonic-example/tests/test.rs:22-120).
 `raft` — MadRaft-class leader election + log replication, the flagship
 benchmark workload (BASELINE.json configs).
+`kv` — versioned KV store + retrying clients, session-monotonicity
+invariant (the etcd-class kill/restart workload).
 """
 
-from . import echo, raft
+from . import echo, kv, raft
 
-__all__ = ["echo", "raft"]
+__all__ = ["echo", "kv", "raft"]

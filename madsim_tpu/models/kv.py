@@ -1,0 +1,189 @@
+"""KV-store consistency machine — the etcd-class engine workload.
+
+BASELINE.json config: "madsim-etcd-client KV linearizability + node
+kill/restart, 10k seeds". Node 0 is a versioned KV server with durable
+state (survives restart faults, like etcd's disk); nodes 1..N-1 are
+clients that PUT with at-least-once retries and then GET.
+
+Checked invariant (code 110, STALE_READ): session monotonicity — a
+client that has an acknowledged write at version v must never observe a
+GET at version < v. Holds for a durable single-copy store under
+partitions and kill/restart; breaks immediately if the store loses
+acknowledged state (e.g. the `DurabilityBugKv` variant in tests that
+drops state on restart), which is exactly the class of bug the workload
+exists to catch.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..engine.machine import Machine, Outbox, make_payload, send_if, set_timer_if, update_node
+
+SERVER = 0
+
+# message types
+M_PUT, M_PUT_OK, M_GET, M_GET_OK = 1, 2, 3, 4
+
+# timers
+T_BOOT, T_TICK, T_RETRY = 0, 1, 2
+
+STALE_READ = 110
+
+TICK_US = 40_000
+RETRY_US = 120_000
+
+
+@struct.dataclass
+class KvState:
+    # server (durable across restart)
+    version: jax.Array  # int32[N] (only SERVER's entry is meaningful)
+    value: jax.Array  # int32[N]
+    # clients (volatile)
+    acked_version: jax.Array  # int32[N] highest version acked to this client
+    next_val: jax.Array  # int32[N]
+    pending_kind: jax.Array  # int32[N] 0=none, M_PUT or M_GET
+    pending_val: jax.Array  # int32[N]
+    reqid: jax.Array  # int32[N]
+    stale: jax.Array  # bool[N] violation observed
+
+
+class KvMachine(Machine):
+    PAYLOAD_WIDTH = 5
+    MAX_MSGS = 1
+    MAX_TIMERS = 2
+
+    def __init__(self, num_nodes: int = 4):
+        self.NUM_NODES = num_nodes
+
+    def init(self, rng_key) -> KvState:
+        n = self.NUM_NODES
+        z = jnp.zeros((n,), jnp.int32)
+        return KvState(
+            version=z,
+            value=z,
+            acked_version=z,
+            next_val=z,
+            pending_kind=z,
+            pending_val=z,
+            reqid=z,
+            stale=jnp.zeros((n,), bool),
+        )
+
+    def init_node(self, nodes: KvState, i, rng_key) -> KvState:
+        """Restart: the server's store is durable; client sessions reset."""
+        is_server = i == SERVER
+        reset = lambda arr: jnp.where(  # noqa: E731
+            (jnp.arange(self.NUM_NODES) == i) & ~is_server, 0, arr
+        )
+        return nodes.replace(
+            acked_version=reset(nodes.acked_version),
+            next_val=reset(nodes.next_val),
+            pending_kind=reset(nodes.pending_kind),
+            pending_val=reset(nodes.pending_val),
+            reqid=reset(nodes.reqid),
+        )
+
+    # -- timers ---------------------------------------------------------------
+
+    def on_timer(self, nodes: KvState, node, timer_id, now_us, rand_u32) -> Tuple[KvState, Outbox]:
+        outbox = self.empty_outbox()
+        is_client = node != SERVER
+        is_boot = timer_id == T_BOOT
+
+        # boot: clients start their op loop
+        outbox = set_timer_if(outbox, 0, is_boot & is_client, TICK_US, T_TICK)
+
+        idle = nodes.pending_kind[node] == 0
+        # tick: issue next op — alternate PUT / GET by next_val parity
+        is_tick = (timer_id == T_TICK) & is_client
+        do_put = is_tick & idle & (nodes.next_val[node] % 2 == 0)
+        do_get = is_tick & idle & (nodes.next_val[node] % 2 == 1)
+        new_reqid = nodes.reqid[node] + 1
+        put_val = node * 100_000 + nodes.next_val[node]
+
+        nodes = update_node(
+            nodes, node,
+            pending_kind=jnp.where(do_put, M_PUT, jnp.where(do_get, M_GET, nodes.pending_kind[node])),
+            pending_val=jnp.where(do_put, put_val, nodes.pending_val[node]),
+            reqid=jnp.where(do_put | do_get, new_reqid, nodes.reqid[node]),
+            next_val=jnp.where(do_put | do_get, nodes.next_val[node] + 1, nodes.next_val[node]),
+        )
+        # send the request; retry timer covers loss/partition/server-down
+        put = make_payload(self.PAYLOAD_WIDTH, M_PUT, node, nodes.reqid[node], nodes.pending_val[node])
+        get = make_payload(self.PAYLOAD_WIDTH, M_GET, node, nodes.reqid[node])
+        outbox = send_if(outbox, 0, do_put, SERVER, put)
+        outbox = send_if(outbox, 0, do_get, SERVER, get)
+        outbox = set_timer_if(outbox, 0, is_tick, TICK_US, T_TICK)
+        outbox = set_timer_if(outbox, 1, do_put | do_get, RETRY_US, T_RETRY)
+
+        # retry: resend the pending op (at-least-once)
+        is_retry = (timer_id == T_RETRY) & is_client & ~idle
+        retry_put = is_retry & (nodes.pending_kind[node] == M_PUT)
+        retry_get = is_retry & (nodes.pending_kind[node] == M_GET)
+        rput = make_payload(self.PAYLOAD_WIDTH, M_PUT, node, nodes.reqid[node], nodes.pending_val[node])
+        rget = make_payload(self.PAYLOAD_WIDTH, M_GET, node, nodes.reqid[node])
+        outbox = send_if(outbox, 0, retry_put, SERVER, rput)
+        outbox = send_if(outbox, 0, retry_get, SERVER, rget)
+        outbox = set_timer_if(outbox, 1, is_retry, RETRY_US, T_RETRY)
+        return nodes, outbox
+
+    # -- messages -------------------------------------------------------------
+
+    def on_message(self, nodes: KvState, node, src, payload, now_us, rand_u32) -> Tuple[KvState, Outbox]:
+        outbox = self.empty_outbox()
+        mtype = payload[0]
+
+        # server side
+        is_server = node == SERVER
+        is_put = is_server & (mtype == M_PUT)
+        client, reqid, val = payload[1], payload[2], payload[3]
+        new_version = nodes.version[SERVER] + 1
+        nodes = update_node(
+            nodes, SERVER,
+            version=jnp.where(is_put, new_version, nodes.version[SERVER]),
+            value=jnp.where(is_put, val, nodes.value[SERVER]),
+        )
+        put_ok = make_payload(self.PAYLOAD_WIDTH, M_PUT_OK, 0, reqid, nodes.version[SERVER])
+        outbox = send_if(outbox, 0, is_put, client, put_ok)
+
+        is_get = is_server & (mtype == M_GET)
+        get_ok = make_payload(
+            self.PAYLOAD_WIDTH, M_GET_OK, 0, reqid, nodes.version[SERVER], nodes.value[SERVER]
+        )
+        outbox = send_if(outbox, 0, is_get, client, get_ok)
+
+        # client side: accept replies matching the current reqid
+        is_client = node != SERVER
+        r_reqid, r_version = payload[2], payload[3]
+        current = r_reqid == nodes.reqid[node]
+        got_put_ok = is_client & (mtype == M_PUT_OK) & current & (nodes.pending_kind[node] == M_PUT)
+        got_get_ok = is_client & (mtype == M_GET_OK) & current & (nodes.pending_kind[node] == M_GET)
+        stale = got_get_ok & (r_version < nodes.acked_version[node])
+        nodes = update_node(
+            nodes, node,
+            acked_version=jnp.where(
+                got_put_ok | got_get_ok,
+                jnp.maximum(nodes.acked_version[node], r_version),
+                nodes.acked_version[node],
+            ),
+            pending_kind=jnp.where(got_put_ok | got_get_ok, 0, nodes.pending_kind[node]),
+            stale=nodes.stale[node] | stale,
+        )
+        return nodes, outbox
+
+    # -- invariants / results ---------------------------------------------------
+
+    def invariant(self, nodes: KvState, now_us):
+        ok = ~jnp.any(nodes.stale)
+        return ok, jnp.where(ok, 0, STALE_READ).astype(jnp.int32)
+
+    def summary(self, nodes: KvState):
+        return {
+            "server_version": nodes.version[SERVER],
+            "total_acked": jnp.sum(nodes.acked_version),
+        }

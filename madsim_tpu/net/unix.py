@@ -1,0 +1,33 @@
+"""Unix domain sockets — intentionally unimplemented API stubs.
+
+Parity with the reference, whose Unix socket bodies are `todo!()`
+(reference: madsim/src/sim/net/unix/{stream,datagram}.rs — C12 in
+SURVEY.md §2: "API exists, bodies todo!() — document as intentionally
+unimplemented"). The types exist so code paths that merely name them
+import cleanly; using them raises NotImplementedError.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class UnixStream:
+    @staticmethod
+    async def connect(path: str) -> "UnixStream":
+        raise NotImplementedError("UnixStream is a stub, as in the reference (todo!())")
+
+
+class UnixListener:
+    @staticmethod
+    async def bind(path: str) -> "UnixListener":
+        raise NotImplementedError("UnixListener is a stub, as in the reference (todo!())")
+
+    async def accept(self) -> Any:
+        raise NotImplementedError("UnixListener is a stub, as in the reference (todo!())")
+
+
+class UnixDatagram:
+    @staticmethod
+    async def bind(path: str) -> "UnixDatagram":
+        raise NotImplementedError("UnixDatagram is a stub, as in the reference (todo!())")

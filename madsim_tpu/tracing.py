@@ -1,0 +1,70 @@
+"""Tracing spans — structured logging context per node/task.
+
+Reference parity (§5.1): every node gets an `error_span!("node")` and
+every task a child span entered on each poll (madsim/src/sim/task/
+mod.rs:116-131, runtime/context.rs:59-66), so log lines carry which
+simulated process emitted them. Here a logging.Filter injects
+`%(sim)s` = "t=<virtual time> node=<name>/<id> task=<id>" into every
+record emitted inside a simulation, plus an `@instrument` decorator for
+span-like entry/exit logs.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Any, Callable
+
+from . import _context
+
+
+class SimContextFilter(logging.Filter):
+    """Injects the current simulation context into log records."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        ctx = _context.try_current()
+        if ctx is None:
+            record.sim = "-"
+            return True
+        t_ns = ctx.executor.time.now_ns()
+        task = ctx.current_task
+        if task is not None:
+            node = task.node
+            record.sim = f"t={t_ns / 1e9:.6f}s node={node.name}/{node.id} task={task.id}"
+        else:
+            record.sim = f"t={t_ns / 1e9:.6f}s node=main"
+        return True
+
+
+def init_tracing(level: str = "INFO") -> None:
+    """Install a handler whose format includes the sim span context
+    (reference: init_logger, sim/runtime/mod.rs:445-449)."""
+    root = logging.getLogger()
+    root.setLevel(getattr(logging, level.upper()))
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter("%(levelname)s [%(sim)s] %(name)s: %(message)s"))
+    handler.addFilter(SimContextFilter())
+    root.addHandler(handler)
+
+
+def instrument(fn: Callable[..., Any] = None, *, name: str = "", level: int = logging.DEBUG):
+    """Span-style decorator: logs entry/exit of an async fn with the sim
+    context (reference: `#[instrument]` on net ops)."""
+
+    def deco(f):
+        span = name or f.__qualname__
+        logger = logging.getLogger(f.__module__)
+
+        @functools.wraps(f)
+        async def wrapper(*args, **kwargs):
+            logger.log(level, "enter %s", span)
+            try:
+                return await f(*args, **kwargs)
+            finally:
+                logger.log(level, "exit %s", span)
+
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco
