@@ -1,0 +1,152 @@
+"""CLI harness — seed exploration, replay, determinism checking.
+
+Build-plan step 7 (SURVEY.md §7): the env-driven multi-seed runner +
+determinism-check mode, as a command line:
+
+  python -m madsim_tpu explore --machine raft --seeds 4096 [--faults 2]
+  python -m madsim_tpu replay  --machine raft --seed 1234 [--tail 30]
+  python -m madsim_tpu check   --machine kv   --seeds 64
+  python -m madsim_tpu bench   [--lanes 4096]
+
+`explore` prints failing seeds (the reference prints
+`MADSIM_TEST_SEED=...` repro hints; here the seed IS the repro:
+`replay --seed N` shows the full event trace).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _build_engine(args):
+    from .engine import Engine, EngineConfig, FaultPlan
+    from .models.echo import EchoMachine
+    from .models.kv import KvMachine
+    from .models.mq import MqMachine
+    from .models.raft import RaftMachine
+
+    machines = {
+        "echo": lambda: EchoMachine(rounds=10),
+        "raft": lambda: RaftMachine(num_nodes=args.nodes or 5, log_capacity=8),
+        "kv": lambda: KvMachine(num_nodes=args.nodes or 4),
+        "mq": lambda: MqMachine(num_nodes=args.nodes or 4),
+    }
+    if args.machine not in machines:
+        sys.exit(f"unknown machine {args.machine!r}; choose from {sorted(machines)}")
+    cfg = EngineConfig(
+        horizon_us=int(args.horizon * 1e6),
+        queue_capacity=args.queue,
+        packet_loss_rate=args.loss,
+        faults=FaultPlan(
+            n_faults=args.faults,
+            t_max_us=int(args.horizon * 0.6e6) or 1,
+            dur_min_us=100_000,
+            dur_max_us=800_000,
+        ),
+    )
+    return Engine(machines[args.machine](), cfg)
+
+
+def cmd_explore(args) -> int:
+    import jax.numpy as jnp
+
+    eng = _build_engine(args)
+    seeds = jnp.arange(args.seed, args.seed + args.seeds, dtype=jnp.uint32)
+    res = eng.make_runner(max_steps=args.max_steps)(seeds)
+    failing = eng.failing_seeds(res).tolist()
+    n_done = int(res.done.sum())
+    print(f"explored {len(seeds.tolist())} seeds ({n_done} completed), "
+          f"{len(failing)} failing")
+    if failing:
+        codes = sorted({int(c) for c in res.fail_code.tolist() if c != 0})
+        print(f"failure codes: {codes}")
+        print(f"failing seeds: {failing[:20]}{' ...' if len(failing) > 20 else ''}")
+        print(
+            f"reproduce: python -m madsim_tpu replay --machine {args.machine} "
+            f"--seed {failing[0]} --nodes {args.nodes} --horizon {args.horizon} "
+            f"--queue {args.queue} --faults {args.faults} --loss {args.loss} "
+            f"--max-steps {args.max_steps}"
+        )
+        return 1
+    return 0
+
+
+def cmd_replay(args) -> int:
+    from .engine import replay
+
+    eng = _build_engine(args)
+    rp = replay(eng, args.seed, max_steps=args.max_steps)
+    events = rp.trace[-args.tail :] if args.tail else rp.trace
+    for ev in events:
+        print(ev)
+    status = f"FAILED (code {rp.fail_code})" if rp.failed else "ok"
+    print(f"seed {args.seed}: {status}, {len(rp.trace)} events, "
+          f"t={int(rp.state.now_us)}us")
+    return 1 if rp.failed else 0
+
+
+def cmd_check(args) -> int:
+    import jax.numpy as jnp
+
+    from .errors import NonDeterminism
+
+    eng = _build_engine(args)
+    seeds = jnp.arange(args.seed, args.seed + args.seeds, dtype=jnp.uint32)
+    try:
+        eng.check_determinism(seeds, max_steps=args.max_steps)
+    except NonDeterminism as exc:
+        print(f"FAIL: {exc}")
+        return 1
+    print(f"determinism check passed for {args.seeds} seeds")
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import bench  # repo-root bench.py when run from checkout
+
+    sys.argv = ["bench.py"] + ([str(args.lanes)] if args.lanes else [])
+    bench.main()
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="madsim_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--machine", default="raft")
+        p.add_argument("--nodes", type=int, default=0)
+        p.add_argument("--seed", type=int, default=0)
+        p.add_argument("--horizon", type=float, default=5.0, help="virtual seconds")
+        p.add_argument("--queue", type=int, default=96)
+        p.add_argument("--faults", type=int, default=2)
+        p.add_argument("--loss", type=float, default=0.0)
+        p.add_argument("--max-steps", type=int, default=3000)
+
+    p = sub.add_parser("explore", help="run a seed batch, report failing seeds")
+    common(p)
+    p.add_argument("--seeds", type=int, default=1024)
+    p.set_defaults(fn=cmd_explore)
+
+    p = sub.add_parser("replay", help="bit-identical replay of one seed with trace")
+    common(p)
+    p.add_argument("--tail", type=int, default=30, help="print last N events (0=all)")
+    p.set_defaults(fn=cmd_replay)
+
+    p = sub.add_parser("check", help="engine determinism self-check")
+    common(p)
+    p.add_argument("--seeds", type=int, default=64)
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("bench", help="flagship benchmark (one JSON line)")
+    p.add_argument("--lanes", type=int, default=0)
+    p.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

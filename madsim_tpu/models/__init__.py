@@ -6,8 +6,10 @@ reference: tonic-example/tests/test.rs:22-120).
 benchmark workload (BASELINE.json configs).
 `kv` — versioned KV store + retrying clients, session-monotonicity
 invariant (the etcd-class kill/restart workload).
+`mq` — idempotent-producer message queue, per-producer gapless ordering
+invariant (the rdkafka-class workload).
 """
 
-from . import echo, kv, raft
+from . import echo, kv, mq, raft
 
-__all__ = ["echo", "kv", "raft"]
+__all__ = ["echo", "kv", "mq", "raft"]

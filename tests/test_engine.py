@@ -196,3 +196,40 @@ def test_kv_machine_catches_durability_bug():
     # and the failing seed replays identically on CPU
     rp = replay(eng, int(failing[0]), max_steps=2500)
     assert rp.failed and rp.fail_code == kvmod.STALE_READ
+
+
+def test_mq_machine_ordering_holds_under_loss():
+    from madsim_tpu.models.mq import MqMachine
+
+    cfg = EngineConfig(
+        horizon_us=6_000_000, queue_capacity=64, packet_loss_rate=0.1,
+        faults=FaultPlan(n_faults=1, t_max_us=3_000_000, dur_min_us=100_000, dur_max_us=400_000),
+    )
+    eng = Engine(MqMachine(4, log_capacity=24, max_seq=10), cfg)
+    res = eng.make_runner(max_steps=3000)(jnp.arange(48, dtype=jnp.uint32))
+    assert bool(res.done.all())
+    assert not bool(res.failed.any()), f"codes: {set(res.fail_code.tolist())}"
+    assert int(jnp.min(res.summary["consumed"])) > 0
+
+
+def test_mq_machine_catches_duplicate_bug():
+    """A broker without producer dedup appends retried records twice;
+    the consumer must observe a duplicate/gap on some seeds."""
+    from madsim_tpu.models import mq as mqmod
+
+    class NoDedupBroker(mqmod.MqMachine):
+        def _accepts(self, nodes, producer, seq):
+            # BUG: accept every PRODUCE, including retried duplicates
+            return jnp.bool_(True)
+
+    cfg = EngineConfig(
+        horizon_us=6_000_000, queue_capacity=64, packet_loss_rate=0.3,
+    )
+    eng = Engine(NoDedupBroker(4, log_capacity=24, max_seq=10), cfg)
+    res = eng.make_runner(max_steps=3000)(jnp.arange(64, dtype=jnp.uint32))
+    failing = eng.failing_seeds(res).tolist()
+    assert len(failing) > 0, "duplicate bug was not caught"
+    codes = {int(c) for c in res.fail_code.tolist() if c != 0}
+    assert mqmod.DUP_OR_GAP in codes
+    rp = replay(eng, int(failing[0]), max_steps=3000)
+    assert rp.failed and rp.fail_code == mqmod.DUP_OR_GAP
