@@ -1,0 +1,31 @@
+"""Examples stay runnable (the reference ships examples as manual tests,
+SURVEY.md §4: madsim/examples/rpc.rs etc.)."""
+
+import subprocess
+import sys
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args):
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", script), *args],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_raft_host_example():
+    r = _run("raft_host.py", "3")
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "3/3 seeds elected a leader" in r.stdout
+
+
+def test_chaos_pipeline_example_deterministic():
+    r1 = _run("chaos_pipeline.py", "7")
+    r2 = _run("chaos_pipeline.py", "7")
+    assert r1.returncode == 0, r1.stderr[-500:]
+    assert r1.stdout == r2.stdout
+    assert "evt-after-crash" in r1.stdout
