@@ -1,0 +1,97 @@
+"""Pallas TPU kernel for the batched event-queue pop.
+
+`pop_earliest` is the per-step hot op of the TPU engine: a lexicographic
+(time, seq) argmin over each lane's Q event slots. The XLA lowering is
+three masked reductions; this Pallas version fuses them into one VMEM
+pass per lane block so the slot arrays are read once
+(guide: /opt/skills/guides/pallas_guide.md — int32 min tile 8x128, lane
+axis = slots).
+
+Everything is min-reductions over the lane axis (argmin is expressed as
+min over an index encoding) — no gathers, no cross-lane shuffles, so the
+kernel lowers cleanly on Mosaic. Until real-chip profiles justify
+flipping the default, the engine keeps the XLA path; this kernel is
+validated against it bit-for-bit in interpreter mode
+(tests/test_pallas.py) and via `pop_earliest_batch(..., use_pallas=True)`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import pop_earliest
+
+try:  # pallas is part of jax, but keep the engine importable without it
+    from jax.experimental import pallas as pl
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+LANE_BLOCK = 8  # lanes per grid step (int32 sublane tile)
+
+
+def _pop_kernel(time_ref, seq_ref, valid_ref, idx_ref, any_ref):
+    """One grid step: LANE_BLOCK lanes x Q slots, fused lexicographic argmin."""
+    t = time_ref[...]
+    s = seq_ref[...]
+    v = valid_ref[...] != 0
+    q = t.shape[-1]
+    # create the sentinel inside the kernel trace (module-level jnp
+    # constants would be captured, which pallas_call rejects)
+    big = jnp.int32(2**31 - 1)
+
+    t_masked = jnp.where(v, t, big)
+    tmin = jnp.min(t_masked, axis=-1, keepdims=True)
+    tie = v & (t == tmin)
+    s_masked = jnp.where(tie, s, big)
+    smin = jnp.min(s_masked, axis=-1, keepdims=True)
+    # argmin = smallest column index among exact (tmin, smin) matches
+    cols = jax.lax.broadcasted_iota(jnp.int32, t.shape, dimension=t.ndim - 1)
+    idx_enc = jnp.where(tie & (s == smin), cols, jnp.int32(q))
+    idx = jnp.min(idx_enc, axis=-1)
+    idx_ref[...] = jnp.where(idx == q, 0, idx)
+    any_ref[...] = jnp.any(v, axis=-1).astype(jnp.int32)
+
+
+def pop_earliest_pallas(eq_time, eq_seq, eq_valid, interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Batched pop over [L, Q] arrays. Returns (idx[L], any_valid[L] bool).
+
+    Input domain: seq values must be < 2**31-1 (the sentinel). The
+    engine's monotone next_seq counter guarantees this by construction;
+    the XLA path shares the same constraint.
+    Non-multiple-of-8 lane counts are padded with invalid rows and the
+    outputs sliced back, so both paths accept arbitrary L."""
+    lanes, q = eq_time.shape
+    pad = (-lanes) % LANE_BLOCK
+    if pad:
+        eq_time = jnp.concatenate([eq_time, jnp.zeros((pad, q), eq_time.dtype)])
+        eq_seq = jnp.concatenate([eq_seq, jnp.zeros((pad, q), eq_seq.dtype)])
+        eq_valid = jnp.concatenate([eq_valid, jnp.zeros((pad, q), bool)])
+    padded = lanes + pad
+    grid = (padded // LANE_BLOCK,)
+    row_spec = pl.BlockSpec((LANE_BLOCK, q), lambda i: (i, 0))
+    out_spec = pl.BlockSpec((LANE_BLOCK,), lambda i: (i,))
+    idx, any_valid = pl.pallas_call(
+        _pop_kernel,
+        grid=grid,
+        in_specs=[row_spec, row_spec, row_spec],
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded,), jnp.int32),
+            jax.ShapeDtypeStruct((padded,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(eq_time, eq_seq, eq_valid.astype(jnp.int32))
+    return idx[:lanes], any_valid[:lanes] != 0
+
+
+def pop_earliest_batch(eq_time, eq_seq, eq_valid, use_pallas: bool = False, interpret: bool = False):
+    """Reference implementation (vmapped XLA) or the fused Pallas kernel."""
+    if use_pallas and HAVE_PALLAS:
+        return pop_earliest_pallas(eq_time, eq_seq, eq_valid, interpret=interpret)
+    return jax.vmap(pop_earliest)(eq_time, eq_seq, eq_valid)

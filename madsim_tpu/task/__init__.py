@@ -22,6 +22,7 @@ __all__ = [
     "JoinHandle",
     "AbortHandle",
     "Builder",
+    "TaskLocal",
     "NodeId",
     "current_node_id",
 ]
@@ -64,6 +65,58 @@ def current_node_id() -> NodeId:
     if ctx.current_task is not None:
         return ctx.current_task.node.id
     return ctx.executor.main_node.id
+
+
+class TaskLocal:
+    """Task-local storage (reference: madsim-tokio keeps tokio's
+    `task_local!`; here it is provided natively).
+
+        REQUEST_ID = TaskLocal()
+        with REQUEST_ID.scope(42):
+            ...  # REQUEST_ID.get() == 42 inside this task
+    """
+
+    def __init__(self) -> None:
+        # weak-keyed by the TaskEntry itself: values cannot bleed into a
+        # different Runtime's task that reuses an id, and entries vanish
+        # with the task (no leak for tasks still in scope at teardown)
+        import weakref
+
+        self._values: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+    class _Scope:
+        def __init__(self, local: "TaskLocal", value: Any):
+            self.local = local
+            self.value = value
+            self.task = None
+            self.had_prev = False
+            self.prev: Any = None
+
+        def __enter__(self):
+            self.task = _context.current_task()
+            self.had_prev = self.task in self.local._values
+            self.prev = self.local._values.get(self.task)
+            self.local._values[self.task] = self.value
+            return self.value
+
+        def __exit__(self, *exc):
+            if self.had_prev:
+                self.local._values[self.task] = self.prev
+            else:
+                self.local._values.pop(self.task, None)
+
+    def scope(self, value: Any) -> "TaskLocal._Scope":
+        return TaskLocal._Scope(self, value)
+
+    def get(self) -> Any:
+        task = _context.current_task()
+        if task not in self._values:
+            raise LookupError("task-local value not set in this task")
+        return self._values[task]
+
+    def try_get(self, default: Any = None) -> Any:
+        task = _context.current_task()
+        return self._values.get(task, default)
 
 
 class Builder:

@@ -60,6 +60,7 @@ class TaskEntry:
     """One spawned task (the Python analogue of an `async-task` Runnable)."""
 
     __slots__ = (
+        "__weakref__",
         "id",
         "coro",
         "node",

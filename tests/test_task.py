@@ -241,3 +241,54 @@ def test_spawn_on_killed_node_is_noop():
         return True
 
     assert Runtime(seed=1).block_on(main()) is True
+
+
+def test_task_local_scoped_per_task():
+    from madsim_tpu.task import TaskLocal
+
+    LOCAL = TaskLocal()
+
+    async def main():
+        results = {}
+
+        async def worker(tag):
+            with LOCAL.scope(tag):
+                await sim_time.sleep(1.0)  # interleave with the other worker
+                results[tag] = LOCAL.get()
+            assert LOCAL.try_get("unset") == "unset"
+
+        h1 = spawn(worker("a"))
+        h2 = spawn(worker("b"))
+        await h1
+        await h2
+        with pytest.raises(LookupError):
+            LOCAL.get()
+        return results
+
+    assert Runtime(seed=1).block_on(main()) == {"a": "a", "b": "b"}
+
+
+def test_task_local_isolated_across_runtimes():
+    # review regression: ids restart per Runtime; values must not bleed
+    from madsim_tpu.task import TaskLocal
+
+    LOCAL = TaskLocal()
+
+    async def leaky():
+        async def stuck():
+            with LOCAL.scope("stale"):
+                await sim_time.sleep(1e9)  # still in scope at teardown
+
+        spawn(stuck())
+        await sim_time.sleep(1.0)
+
+    rt1 = Runtime(seed=1)
+    rt1.block_on(leaky())
+
+    async def fresh():
+        async def probe():
+            return LOCAL.try_get("clean")
+
+        return await spawn(probe())
+
+    assert Runtime(seed=2).block_on(fresh()) == "clean"
