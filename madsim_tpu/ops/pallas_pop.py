@@ -17,7 +17,6 @@ validated against it bit-for-bit in interpreter mode
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax
