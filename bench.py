@@ -10,15 +10,55 @@ replicate an 8-entry log under 2 random partition/kill faults, verify
 election + log-matching invariants on every event, horizon 5 virtual
 seconds (a lane typically processes ~200-400 events).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus a
+"platform" key ("tpu"/"axon" vs "cpu") that distinguishes a real-chip
+number from the watchdog's CPU-fallback path.
 """
 
 import json
+import os
 import sys
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
+
+def _ensure_live_backend() -> None:
+    """The axon TPU plugin can wedge (PJRT client creation hangs forever
+    if the tunnel is down). Probe device init with a watchdog; on hang,
+    re-exec with the plugin disabled so the bench still reports a real
+    (CPU) number instead of timing out the driver."""
+    if os.environ.get("_MADSIM_TPU_BENCH_REEXEC"):
+        return
+    result: dict = {}
+
+    def probe() -> None:
+        try:
+            import jax
+
+            result["devices"] = [str(d) for d in jax.devices()]
+        except Exception as exc:  # noqa: BLE001
+            result["error"] = str(exc)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=120)
+    if t.is_alive() or "error" in result:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["_MADSIM_TPU_BENCH_REEXEC"] = "1"
+        print(
+            "bench: accelerator backend unresponsive; falling back to CPU",
+            file=sys.stderr,
+            flush=True,
+        )
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+_ensure_live_backend()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 
 
 def main() -> None:
@@ -58,6 +98,7 @@ def main() -> None:
                 "value": round(seeds_per_sec, 1),
                 "unit": "seeds/sec",
                 "vs_baseline": round(seeds_per_sec / per_chip_target, 3),
+                "platform": jax.devices()[0].platform,
             }
         )
     )

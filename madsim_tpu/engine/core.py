@@ -229,8 +229,13 @@ class Engine:
         pop_mask = (jnp.arange(s.eq_valid.shape[0]) == idx) & any_valid
         eq_valid = s.eq_valid & ~pop_mask
 
-        key, k_handler, k_restart, k_lat, k_drop = jax.random.split(s.rng_key, 5)
-        rand_u32 = jax.random.bits(k_handler, (cfg.handler_rand_words,), jnp.uint32)
+        # One batched draw covers the step's randomness (handler words,
+        # per-message latency + drop draws); k_restart is its own split —
+        # never derived from a consumed key (stream-collision hazard).
+        key, k_step, k_restart = jax.random.split(s.rng_key, 3)
+        n_words = cfg.handler_rand_words + 2 * m.MAX_MSGS
+        step_words = jax.random.bits(k_step, (n_words,), jnp.uint32)
+        rand_u32 = step_words[: cfg.handler_rand_words]
 
         node_alive = ~s.killed[ev_node]
 
@@ -292,8 +297,8 @@ class Engine:
         msg_count = s.msg_count
 
         lat_span = max(1, cfg.latency_max_us - cfg.latency_min_us)
-        lat_bits = jax.random.bits(k_lat, (m.MAX_MSGS,), jnp.uint32)
-        drop_bits = jax.random.bits(k_drop, (m.MAX_MSGS,), jnp.uint32)
+        lat_bits = step_words[cfg.handler_rand_words : cfg.handler_rand_words + m.MAX_MSGS]
+        drop_bits = step_words[cfg.handler_rand_words + m.MAX_MSGS :]
         loss_threshold = jnp.uint32(int(cfg.packet_loss_rate * 0xFFFFFFFF))
 
         for mi in range(m.MAX_MSGS):
