@@ -47,8 +47,9 @@ def _ensure_live_backend() -> None:
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
         env["_MADSIM_TPU_BENCH_REEXEC"] = "1"
+        cause = result.get("error", "device init hung >120s")
         print(
-            "bench: accelerator backend unresponsive; falling back to CPU",
+            f"bench: accelerator backend unavailable ({cause}); falling back to CPU",
             file=sys.stderr,
             flush=True,
         )

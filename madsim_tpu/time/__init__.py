@@ -294,10 +294,16 @@ async def timeout(duration: Union[int, float], fut: Union[Pollable, Awaitable]) 
             return value
         raise TimeoutError(f"timed out after {duration}s (virtual)")
     handle = spawn(fut)
-    idx, value = await await_(_Race([handle, deadline]))
+    try:
+        idx, value = await await_(_Race([handle, deadline]))
+    finally:
+        # Expiry, surrounding cancellation, or inner panic all abort the
+        # helper task, cascading like dropping a future tree (nested
+        # timeouts cancel their children; reference/tokio drop semantics).
+        if not handle.is_finished():
+            handle.abort()
     if idx == 0:
         return value
-    handle.abort()
     raise TimeoutError(f"timed out after {duration}s (virtual)")
 
 

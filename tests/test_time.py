@@ -125,3 +125,62 @@ def test_deadlock_detection():
 
     with pytest.raises(Deadlock):
         run(main)
+
+
+def test_interval_missed_tick_behaviors():
+    # reference: sim/time/interval.rs MissedTickBehavior {Burst, Delay, Skip}
+    from madsim_tpu.time import MissedTickBehavior
+
+    def run_with(behavior):
+        async def main():
+            it = sim_time.interval(1.0)
+            it.missed_tick_behavior = behavior
+            await it.tick()          # immediate first tick
+            sim_time.advance(3.5)    # miss ~3 ticks
+            ticks = []
+            for _ in range(3):
+                await it.tick()
+                ticks.append(round(sim_time.now(), 2))
+            return ticks
+
+        return run(main)
+
+    burst = run_with(MissedTickBehavior.Burst)
+    # burst catches up: back-to-back late ticks
+    assert burst[0] == burst[1] == burst[2] == pytest.approx(3.5, abs=0.1)
+
+    delay = run_with(MissedTickBehavior.Delay)
+    # delay reschedules from now: ~1s apart after the late tick
+    assert delay[0] == pytest.approx(3.5, abs=0.1)
+    assert delay[1] == pytest.approx(4.5, abs=0.1)
+    assert delay[2] == pytest.approx(5.5, abs=0.1)
+
+    skip = run_with(MissedTickBehavior.Skip)
+    # skip drops missed ticks and stays aligned to the original phase
+    assert skip[0] == pytest.approx(3.5, abs=0.1)
+    assert skip[1] == pytest.approx(4.0, abs=0.1)
+    assert skip[2] == pytest.approx(5.0, abs=0.1)
+
+
+def test_nested_timeouts_cancel_cascade():
+    async def main():
+        ran = {"inner": False}
+
+        async def inner():
+            await sim_time.sleep(10.0)
+            ran["inner"] = True
+            return "inner-done"
+
+        async def outer():
+            return await sim_time.timeout(5.0, inner())
+
+        with pytest.raises(TimeoutError):
+            await sim_time.timeout(2.0, outer())
+        t_fired = round(sim_time.now(), 2)
+        # cancelled inner work must never run (drop-cancels-children)
+        await sim_time.sleep(20.0)
+        return t_fired, ran["inner"]
+
+    t_fired, inner_ran = run(main)
+    assert t_fired == pytest.approx(2.0, abs=0.1)  # outer timeout fires first
+    assert inner_ran is False
