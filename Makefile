@@ -1,0 +1,42 @@
+# Dual-mode test/bench targets (reference: madsim's Makefile drives
+# `cargo test` and `RUSTFLAGS="--cfg madsim" cargo test`; here the modes
+# are sim [default], real sockets, and the TPU engine CLI).
+
+PY ?= python
+
+.PHONY: test stest rtest check bench rpc-bench explore examples
+
+# full suite (host engine + TPU engine on a hermetic 8-dev CPU mesh)
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# sim-only subset (fast; no jax)
+stest:
+	$(PY) -m pytest tests/ -x -q --ignore=tests/test_engine.py \
+		--ignore=tests/test_pallas.py --ignore=tests/test_soak.py \
+		--ignore=tests/test_native.py
+
+# real-socket mode
+rtest:
+	$(PY) -m pytest tests/test_real_mode.py -x -q
+
+# determinism self-checks (host harness + engine)
+check:
+	MADSIM_TEST_NUM=8 MADSIM_TEST_CHECK_DETERMINISM=1 \
+		$(PY) -m pytest tests/test_rand.py -x -q
+	$(PY) -m madsim_tpu check --machine raft --seeds 32
+
+# flagship benchmark (one JSON line; real chip when available)
+bench:
+	$(PY) bench.py
+
+# reference-criterion-style microbenches
+rpc-bench:
+	$(PY) benches/rpc_bench.py
+
+explore:
+	$(PY) -m madsim_tpu explore --machine raft --seeds 4096
+
+examples:
+	$(PY) examples/raft_host.py 10
+	$(PY) examples/chaos_pipeline.py 42

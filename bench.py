@@ -18,42 +18,14 @@ number from the watchdog's CPU-fallback path.
 import json
 import os
 import sys
-import threading
 import time
 
 
 def _ensure_live_backend() -> None:
-    """The axon TPU plugin can wedge (PJRT client creation hangs forever
-    if the tunnel is down). Probe device init with a watchdog; on hang,
-    re-exec with the plugin disabled so the bench still reports a real
-    (CPU) number instead of timing out the driver."""
-    if os.environ.get("_MADSIM_TPU_BENCH_REEXEC"):
-        return
-    result: dict = {}
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from madsim_tpu._backend_watchdog import ensure_live_backend
 
-    def probe() -> None:
-        try:
-            import jax
-
-            result["devices"] = [str(d) for d in jax.devices()]
-        except Exception as exc:  # noqa: BLE001
-            result["error"] = str(exc)
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout=120)
-    if t.is_alive() or "error" in result:
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["_MADSIM_TPU_BENCH_REEXEC"] = "1"
-        cause = result.get("error", "device init hung >120s")
-        print(
-            f"bench: accelerator backend unavailable ({cause}); falling back to CPU",
-            file=sys.stderr,
-            flush=True,
-        )
-        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    ensure_live_backend()
 
 
 _ensure_live_backend()

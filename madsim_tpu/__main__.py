@@ -145,6 +145,10 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_bench)
 
     args = parser.parse_args(argv)
+    from ._backend_watchdog import ensure_live_backend
+
+    cli_args = list(argv) if argv is not None else sys.argv[1:]
+    ensure_live_backend(argv=["-m", "madsim_tpu"] + cli_args)
     return args.fn(args)
 
 

@@ -1,0 +1,58 @@
+"""Accelerator-backend watchdog shared by CLI entry points.
+
+The environment's TPU plugin can wedge PJRT client creation forever if
+its tunnel is down (observed in round 1: `make_c_api_client` hangs; even
+the CPU backend blocks once the plugin is registered). Entry points that
+must always produce output (bench.py, `python -m madsim_tpu`) probe
+device init on a watchdog thread and re-exec themselves onto a clean CPU
+backend when the accelerator is unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+_REEXEC_FLAG = "_MADSIM_TPU_BACKEND_REEXEC"
+_OK_FLAG = "_MADSIM_TPU_BACKEND_OK"
+
+
+def ensure_live_backend(timeout_s: float = 120.0, argv=None) -> None:
+    """Verify jax device init completes; on hang/error, re-exec the current
+    process with the accelerator plugin disabled and JAX_PLATFORMS=cpu.
+
+    `argv` overrides the re-exec command line (after the interpreter) —
+    needed for `-m package` invocations, where sys.argv[0] is the
+    __main__.py path and re-running it as a script breaks relative
+    imports."""
+    if os.environ.get(_REEXEC_FLAG) or os.environ.get(_OK_FLAG):
+        return
+    result: dict = {}
+
+    def probe() -> None:
+        try:
+            import jax
+
+            result["devices"] = [str(d) for d in jax.devices()]
+        except Exception as exc:  # noqa: BLE001
+            result["error"] = str(exc)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if t.is_alive() or "error" in result:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env[_REEXEC_FLAG] = "1"
+        cause = result.get("error", f"device init hung >{timeout_s:.0f}s")
+        print(
+            f"madsim_tpu: accelerator backend unavailable ({cause}); "
+            f"falling back to CPU",
+            file=sys.stderr,
+            flush=True,
+        )
+        os.execve(sys.executable, [sys.executable] + (argv or sys.argv), env)
+    # healthy: remember so later calls (and children) skip the probe
+    os.environ[_OK_FLAG] = "1"
