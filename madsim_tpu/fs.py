@@ -1,10 +1,12 @@
 """Simulated filesystem (reference: madsim/src/sim/fs.rs).
 
 Per-node in-memory inode map with positional read/write, metadata and
-read-only enforcement. `power_fail` (dropping unsynced buffered writes)
-is a documented stub in the reference (fs.rs:50-53,:205-207); here it
-clears nothing yet either, but the hook exists and is called on node
-reset so chaos scenarios can opt in later.
+read-only enforcement. Write durability is modeled with a working copy
+(page cache) and a durable copy per inode: all mutations (including
+create-truncate) hit the working copy, `sync_all`/`sync_data` snapshot
+it durable, and a node kill/restart triggers `power_fail`, restoring the
+working copy from durable — the behavior the reference marks TODO
+(fs.rs:50-53,:205-207) but whose hook it already wires to reset_node.
 """
 
 from __future__ import annotations
@@ -21,13 +23,26 @@ class FsError(SimError):
 
 
 class INode:
-    """Reference: fs.rs:125 `INode`."""
+    """Reference: fs.rs:125 `INode`.
 
-    __slots__ = ("data", "readonly")
+    Two copies model durability: `working` is what the running node
+    reads/writes (a page cache), `durable` is what survives power
+    failure. `sync_all` snapshots working -> durable; `power_fail`
+    restores working <- durable. All mutations (including create/
+    truncate) are working-copy operations until synced."""
+
+    __slots__ = ("durable", "working", "readonly")
 
     def __init__(self) -> None:
-        self.data = bytearray()
+        self.durable = bytearray()
+        self.working = bytearray()
         self.readonly = False
+
+    def sync(self) -> None:
+        self.durable = bytearray(self.working)
+
+    def power_fail(self) -> None:
+        self.working = bytearray(self.durable)
 
 
 class FsSim(Simulator):
@@ -46,8 +61,10 @@ class FsSim(Simulator):
         self.power_fail(node_id)
 
     def power_fail(self, node_id: int) -> None:
-        """Stub (reference: fs.rs:50-53): buffered-write loss not yet
-        simulated; files persist across restarts like synced data."""
+        """Drop all unsynced writes (reference: fs.rs:50-53 marks this
+        TODO; implemented here). Synced data survives."""
+        for inode in self._nodes.get(node_id, {}).values():
+            inode.power_fail()
 
     def fs_of(self, node_id: int) -> Dict[str, INode]:
         return self._nodes.setdefault(node_id, {})
@@ -95,20 +112,20 @@ class File:
             fs[path] = inode
         if inode.readonly:
             raise FsError(f"file is read-only: {path}")
-        inode.data = bytearray()
+        inode.working = bytearray()  # truncate is unsynced like any write
         return File(inode, writable=True)
 
     async def read_at(self, buf_len: int, offset: int) -> bytes:
-        data = self._inode.data
-        return bytes(data[offset : offset + buf_len])
+        return bytes(self._inode.working[offset : offset + buf_len])
 
     async def read_all(self) -> bytes:
-        return bytes(self._inode.data)
+        return bytes(self._inode.working)
 
     async def write_all_at(self, data: bytes, offset: int) -> None:
+        """Working-copy write: lost on power_fail until sync_all."""
         if not self._writable or self._inode.readonly:
             raise FsError("file is read-only")
-        buf = self._inode.data
+        buf = self._inode.working
         end = offset + len(data)
         if len(buf) < end:
             buf.extend(b"\x00" * (end - len(buf)))
@@ -117,17 +134,20 @@ class File:
     async def set_len(self, size: int) -> None:
         if not self._writable or self._inode.readonly:
             raise FsError("file is read-only")
-        buf = self._inode.data
+        buf = self._inode.working
         if len(buf) > size:
             del buf[size:]
         else:
             buf.extend(b"\x00" * (size - len(buf)))
 
     async def sync_all(self) -> None:
-        pass
+        """Flush to durable storage (reference: fsync)."""
+        self._inode.sync()
+
+    sync_data = sync_all
 
     async def metadata(self) -> Metadata:
-        return Metadata(len(self._inode.data), self._inode.readonly)
+        return Metadata(len(self._inode.working), self._inode.readonly)
 
 
 async def read(path: str) -> bytes:
@@ -136,8 +156,10 @@ async def read(path: str) -> bytes:
 
 
 async def write(path: str, data: bytes) -> None:
+    """Convenience write: durable on return (create + write + sync)."""
     f = await File.create(path)
     await f.write_all_at(data, 0)
+    await f.sync_all()
 
 
 async def remove_file(path: str) -> None:
@@ -152,7 +174,7 @@ async def metadata(path: str) -> Metadata:
     if path not in fs:
         raise FsError(f"file not found: {path}")
     inode = fs[path]
-    return Metadata(len(inode.data), inode.readonly)
+    return Metadata(len(inode.working), inode.readonly)
 
 
 def set_readonly(path: str, readonly: bool = True) -> None:

@@ -97,3 +97,66 @@ def test_builder_check_determinism_mode():
             await sim_time.sleep(0.01)
 
     b.run(workload)  # should not raise
+
+
+def test_fs_power_fail_drops_unsynced_writes():
+    # implemented beyond the reference's TODO: kill == power failure;
+    # synced data survives, buffered writes vanish
+    async def main():
+        from madsim_tpu.runtime import Handle
+
+        handle = Handle.current()
+        observed = {}
+
+        async def app():
+            f = await fs.File.create("/db")
+            await f.write_all_at(b"durable", 0)
+            await f.sync_all()
+            await f.write_all_at(b"volatile", 7)
+            assert await f.read_all() == b"durablevolatile"  # node sees its own writes
+            await sim_time.sleep(1e9)
+
+        async def app_after_restart():
+            observed["data"] = await fs.read("/db")
+            await sim_time.sleep(1e9)
+
+        node = handle.create_node().init(app).build()
+        await sim_time.sleep(1.0)
+        handle.kill(node.id)  # power failure
+        # restart with a different init that inspects the disk
+        handle._runtime.executor.nodes[node.id].init = app_after_restart
+        handle.restart(node.id)
+        await sim_time.sleep(1.0)
+        return observed["data"]
+
+    assert Runtime(seed=1).block_on(main()) == b"durable"
+
+
+def test_fs_create_truncate_is_unsynced():
+    # review regression: rewriting a file without sync must not destroy
+    # the previously-synced content on power failure
+    async def main():
+        from madsim_tpu.runtime import Handle
+
+        handle = Handle.current()
+        out = {}
+
+        async def app():
+            await fs.write("/cfg2", b"v1")          # durable
+            f = await fs.File.create("/cfg2")        # truncate (unsynced)
+            await f.write_all_at(b"v2-partial", 0)   # unsynced
+            await sim_time.sleep(1e9)
+
+        async def check():
+            out["data"] = await fs.read("/cfg2")
+            await sim_time.sleep(1e9)
+
+        node = handle.create_node().init(app).build()
+        await sim_time.sleep(0.5)
+        handle.kill(node.id)
+        handle._runtime.executor.nodes[node.id].init = check
+        handle.restart(node.id)
+        await sim_time.sleep(0.5)
+        return out["data"]
+
+    assert Runtime(seed=1).block_on(main()) == b"v1"
