@@ -31,7 +31,7 @@ from .machine import (
     set_timer_if,
     update_node,
 )
-from .replay import ReplayResult, TraceEvent, replay
+from .replay import ReplayResult, TraceEvent, replay, replay_diff
 
 __all__ = [
     "BatchResult",
@@ -49,6 +49,7 @@ __all__ = [
     "set_timer_if",
     "update_node",
     "replay",
+    "replay_diff",
     "ReplayResult",
     "TraceEvent",
     "EV_TIMER",

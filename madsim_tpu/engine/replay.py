@@ -53,6 +53,44 @@ class ReplayResult:
         return int(self.state.fail_code)
 
 
+def replay_diff(
+    engine: Engine,
+    seed_a: int,
+    seed_b: int,
+    max_steps: int = 10_000,
+    context: int = 3,
+) -> Optional[int]:
+    """Debugging aid: replay two seeds and report the first step where
+    their event streams diverge (printing `context` events around it).
+    Returns the diverging step index, or None if the shorter trace is a
+    prefix of the longer (seeds that only differ later in latencies).
+
+    Typical use: diff a failing seed against its nearest passing
+    neighbor to see where the schedules fork."""
+    ra = replay(engine, seed_a, max_steps=max_steps)
+    rb = replay(engine, seed_b, max_steps=max_steps)
+
+    def key(ev: TraceEvent):
+        return (ev.time_us, ev.kind, ev.node, ev.src, ev.payload)
+
+    for i, (ea, eb) in enumerate(zip(ra.trace, rb.trace)):
+        if key(ea) != key(eb):
+            lo = max(0, i - context)
+            print(f"traces diverge at step {i}:")
+            for j in range(lo, min(i + context + 1, min(len(ra.trace), len(rb.trace)))):
+                marker = ">>" if j == i else "  "
+                print(f"{marker} seed {seed_a}: {ra.trace[j]}")
+                print(f"{marker} seed {seed_b}: {rb.trace[j]}")
+            return i
+    la, lb = len(ra.trace), len(rb.trace)
+    if la != lb:
+        print(f"trace of seed {seed_a} ({la} events) is a prefix-match of "
+              f"seed {seed_b} ({lb} events); no per-event divergence")
+    else:
+        print(f"seeds {seed_a} and {seed_b} produced identical {la}-event traces")
+    return None
+
+
 def replay(
     engine: Engine,
     seed: int,

@@ -28,21 +28,28 @@ class INode:
     Two copies model durability: `working` is what the running node
     reads/writes (a page cache), `durable` is what survives power
     failure. `sync_all` snapshots working -> durable; `power_fail`
-    restores working <- durable. All mutations (including create/
-    truncate) are working-copy operations until synced."""
+    restores working <- durable. All mutations — content writes,
+    truncation, and the namespace ops create/unlink — are working-level
+    until synced: an unsynced create vanishes on power failure and an
+    unsynced unlink rolls back."""
 
-    __slots__ = ("durable", "working", "readonly")
+    __slots__ = ("durable", "working", "readonly", "exists_durable", "removed")
 
     def __init__(self) -> None:
         self.durable = bytearray()
         self.working = bytearray()
         self.readonly = False
+        self.exists_durable = False  # creation not yet fsynced
+        self.removed = False  # unlinked in the working view
 
     def sync(self) -> None:
         self.durable = bytearray(self.working)
+        if not self.removed:
+            self.exists_durable = True
 
     def power_fail(self) -> None:
         self.working = bytearray(self.durable)
+        self.removed = False  # an unsynced unlink rolls back
 
 
 class FsSim(Simulator):
@@ -61,9 +68,13 @@ class FsSim(Simulator):
         self.power_fail(node_id)
 
     def power_fail(self, node_id: int) -> None:
-        """Drop all unsynced writes (reference: fs.rs:50-53 marks this
-        TODO; implemented here). Synced data survives."""
-        for inode in self._nodes.get(node_id, {}).values():
+        """Drop all unsynced state — content AND namespace ops
+        (reference: fs.rs:50-53 marks this TODO; implemented here).
+        Synced data survives."""
+        files = self._nodes.get(node_id, {})
+        for path in [p for p, ino in files.items() if not ino.exists_durable]:
+            del files[path]  # unsynced creations vanish
+        for inode in files.values():
             inode.power_fail()
 
     def fs_of(self, node_id: int) -> Dict[str, INode]:
@@ -99,9 +110,10 @@ class File:
     @staticmethod
     async def open(path: str) -> "File":
         fs = _current_fs()
-        if path not in fs:
+        inode = fs.get(path)
+        if inode is None or inode.removed:
             raise FsError(f"file not found: {path}")
-        return File(fs[path], writable=not fs[path].readonly)
+        return File(inode, writable=not inode.readonly)
 
     @staticmethod
     async def create(path: str) -> "File":
@@ -113,6 +125,7 @@ class File:
         if inode.readonly:
             raise FsError(f"file is read-only: {path}")
         inode.working = bytearray()  # truncate is unsynced like any write
+        inode.removed = False  # re-creating an unlinked name (unsynced)
         return File(inode, writable=True)
 
     async def read_at(self, buf_len: int, offset: int) -> bytes:
@@ -163,17 +176,23 @@ async def write(path: str, data: bytes) -> None:
 
 
 async def remove_file(path: str) -> None:
+    """Unlink: working-level until power failure or durable GC — an
+    unsynced unlink rolls back on crash."""
     fs = _current_fs()
-    if path not in fs:
+    inode = fs.get(path)
+    if inode is None or inode.removed:
         raise FsError(f"file not found: {path}")
-    del fs[path]
+    if inode.exists_durable:
+        inode.removed = True
+    else:
+        del fs[path]  # never durable: gone outright
 
 
 async def metadata(path: str) -> Metadata:
     fs = _current_fs()
-    if path not in fs:
+    inode = fs.get(path)
+    if inode is None or inode.removed:
         raise FsError(f"file not found: {path}")
-    inode = fs[path]
     return Metadata(len(inode.working), inode.readonly)
 
 

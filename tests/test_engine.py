@@ -233,3 +233,12 @@ def test_mq_machine_catches_duplicate_bug():
     assert mqmod.DUP_OR_GAP in codes
     rp = replay(eng, int(failing[0]), max_steps=3000)
     assert rp.failed and rp.fail_code == mqmod.DUP_OR_GAP
+
+
+def test_replay_diff_finds_divergence(echo_engine):
+    from madsim_tpu.engine import replay_diff
+
+    # different seeds diverge somewhere; same seed is identical
+    step = replay_diff(echo_engine, 1, 2, max_steps=500)
+    assert step is not None and step >= 0
+    assert replay_diff(echo_engine, 3, 3, max_steps=500) is None

@@ -160,3 +160,40 @@ def test_fs_create_truncate_is_unsynced():
         return out["data"]
 
     assert Runtime(seed=1).block_on(main()) == b"v1"
+
+
+def test_fs_namespace_crash_consistency():
+    # review regression: unsynced create vanishes; unsynced unlink rolls back
+    async def main():
+        from madsim_tpu.runtime import Handle
+
+        handle = Handle.current()
+        out = {}
+
+        async def app():
+            f = await fs.File.create("/never-synced")
+            await f.write_all_at(b"x", 0)
+            await fs.write("/durable", b"keep")  # synced
+            await fs.remove_file("/durable")     # unsynced unlink
+            await sim_time.sleep(1e9)
+
+        async def check():
+            try:
+                await fs.File.open("/never-synced")
+                out["ghost"] = True
+            except fs.FsError:
+                out["ghost"] = False
+            out["durable"] = await fs.read("/durable")  # unlink rolled back
+            await sim_time.sleep(1e9)
+
+        node = handle.create_node().init(app).build()
+        await sim_time.sleep(0.5)
+        handle.kill(node.id)
+        handle._runtime.executor.nodes[node.id].init = check
+        handle.restart(node.id)
+        await sim_time.sleep(0.5)
+        return out
+
+    out = Runtime(seed=1).block_on(main())
+    assert out["ghost"] is False  # unsynced creation did not survive
+    assert out["durable"] == b"keep"  # unsynced unlink was rolled back
