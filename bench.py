@@ -45,22 +45,16 @@ def main() -> None:
         faults=FaultPlan(n_faults=2, t_max_us=3_000_000, dur_min_us=200_000, dur_max_us=800_000),
     )
     eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
-    run = eng.make_runner(max_steps=3000)
 
-    # warmup / compile
-    res = run(jnp.arange(lanes, dtype=jnp.uint32))
-    jax.block_until_ready(res.done)
+    # warmup / compile the streaming path at the timed batch size
+    eng.run_stream(1, batch=lanes, segment_steps=192)
 
-    # timed runs on fresh seed batches (no caching of results possible)
-    reps = 3
+    # timed: seed streaming keeps every lane busy (finished lanes refill
+    # with fresh seeds each segment, so stragglers never idle the batch)
     t0 = time.perf_counter()
-    total = 0
-    for r in range(reps):
-        seeds = jnp.arange(1_000_000 * (r + 1), 1_000_000 * (r + 1) + lanes, dtype=jnp.uint32)
-        res = run(seeds)
-        jax.block_until_ready(res.done)
-        total += int(res.done.sum())
+    out = eng.run_stream(3 * lanes, batch=lanes, segment_steps=192, seed_start=1_000_000)
     elapsed = time.perf_counter() - t0
+    total = out["completed"]
 
     seeds_per_sec = total / elapsed
     per_chip_target = 10_000 / 8  # north star is for a v5e-8; we have 1 chip

@@ -242,3 +242,66 @@ def test_replay_diff_finds_divergence(echo_engine):
     step = replay_diff(echo_engine, 1, 2, max_steps=500)
     assert step is not None and step >= 0
     assert replay_diff(echo_engine, 3, 3, max_steps=500) is None
+
+
+def test_run_stream_completes_and_is_deterministic(raft_engine):
+    out1 = raft_engine.run_stream(48, batch=24, segment_steps=128, seed_start=500)
+    out2 = raft_engine.run_stream(48, batch=24, segment_steps=128, seed_start=500)
+    assert out1["completed"] >= 48
+    assert out1 == out2  # streaming is as deterministic as the batch path
+    assert out1["failing"] == []
+
+
+def test_run_stream_reports_failing_seeds():
+    from madsim_tpu.models.raft import ELECTION_SAFETY
+
+    class BuggyRaft(RaftMachine):
+        def _rand_timeout(self, rand_word):
+            return jnp.int32(50_000) + (rand_word % jnp.uint32(1_000)).astype(jnp.int32)
+
+        def on_message(self, nodes, node, src, payload, now_us, rand_u32):
+            from madsim_tpu.engine.machine import send_if
+            from madsim_tpu.models import raft as R
+
+            nodes2, outbox = super().on_message(nodes, node, src, payload, now_us, rand_u32)
+            vote = self._pay(R.M_VOTE, jnp.maximum(payload[1], nodes.term[node]), 1)
+            return nodes2, send_if(outbox, 0, payload[0] == R.M_RV, src, vote)
+
+    eng = Engine(BuggyRaft(5, 8), EngineConfig(horizon_us=3_000_000, queue_capacity=96))
+    out = eng.run_stream(64, batch=32, segment_steps=192)
+    assert len(out["failing"]) > 0
+    assert all(code == ELECTION_SAFETY for _seed, code in out["failing"])
+    # a streamed failing seed replays identically
+    seed, code = out["failing"][0]
+    rp = replay(eng, seed, max_steps=3000)
+    assert rp.failed and rp.fail_code == code
+
+
+def test_run_stream_gapless_seed_coverage(raft_engine):
+    # review regression: every seed in [start, start+consumed) actually
+    # runs — failing seeds from a buggy machine confirm full coverage
+    class AlwaysFails(RaftMachine):
+        def invariant(self, nodes, now_us):
+            return jnp.bool_(False), jnp.int32(99)
+
+    eng = Engine(AlwaysFails(3, 4), EngineConfig(horizon_us=1_000_000, queue_capacity=48))
+    out = eng.run_stream(40, batch=16, segment_steps=64, seed_start=100)
+    failing_seeds = sorted(s for s, _ in out["failing"])
+    # gapless: exactly the consumed prefix, no holes, no duplicates
+    assert failing_seeds == list(range(100, 100 + out["seeds_consumed"]))
+    assert out["completed"] == out["seeds_consumed"]
+
+
+def test_run_stream_abandons_livelocked_lanes():
+    # review regression: a lane that never finishes is step-capped and
+    # reported as abandoned, not spun forever
+    class Livelock(RaftMachine):
+        def is_done(self, nodes, now_us):
+            return jnp.bool_(False)
+
+    # horizon far beyond max_steps so lanes cannot finish by time
+    eng = Engine(Livelock(3, 8), EngineConfig(horizon_us=2_000_000_000, queue_capacity=64))
+    out = eng.run_stream(8, batch=8, segment_steps=128, max_steps=512)
+    assert out["completed"] >= 8
+    assert len(out["abandoned"]) >= 8
+    assert out["failing"] == []
