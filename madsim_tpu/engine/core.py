@@ -445,6 +445,7 @@ class Engine:
         segment_steps: int = 256,
         seed_start: int = 0,
         max_steps: int = 10_000,
+        mesh=None,
     ):
         """Continuous seed streaming: run at least n_seeds simulations
         keeping every lane busy. After each segment, finished lanes are
@@ -457,6 +458,10 @@ class Engine:
         (done lanes take the next consecutive seeds via a cumsum rank).
         Lanes exceeding `max_steps` events are abandoned and reported.
 
+        With `mesh`, the lane axis shards over the mesh's "seeds" axis and
+        every streaming op (init / segment / refill) stays sharded by
+        propagation — the 100k-seeds-over-a-pod configuration.
+
         Returns {"completed", "failing": [(seed, code)...],
         "abandoned": [seed...], "seeds_consumed"}.
         """
@@ -466,6 +471,10 @@ class Engine:
 
         next_seed = seed_start
         seeds = jnp.arange(next_seed, next_seed + batch, dtype=jnp.uint32)
+        if mesh is not None:
+            from ..parallel import shard_seeds
+
+            seeds = shard_seeds(seeds, mesh)  # validates mesh axis + batch
         next_seed += batch
         state = init(seeds)
         completed = 0
@@ -519,13 +528,10 @@ class Engine:
         if mesh is None:
             return fn
 
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        sharding = NamedSharding(mesh, P("seeds"))
+        from ..parallel import shard_seeds
 
         def sharded(seeds):
-            seeds = jax.device_put(seeds, sharding)
-            return fn(seeds)
+            return fn(shard_seeds(seeds, mesh))
 
         return sharded
 

@@ -31,8 +31,23 @@ def seed_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_seeds(seeds, mesh: Mesh):
-    """Place a seed batch sharded over the mesh; the engine's whole state
-    inherits the lane sharding by propagation."""
+    """Place a seed batch sharded over the mesh's "seeds" axis; the
+    engine's whole state inherits the lane sharding by propagation.
+
+    Validates the mesh and batch shape up front so every sharding entry
+    point gets a clear error instead of a raw XLA one."""
+    if SEED_AXIS not in mesh.shape:
+        raise ValueError(
+            f'mesh has no "{SEED_AXIS}" axis (axes: {tuple(mesh.shape)}); '
+            f"build it with parallel.make_mesh(...)"
+        )
+    axis = mesh.shape[SEED_AXIS]
+    n = len(seeds)
+    if n % axis != 0:
+        raise ValueError(
+            f"seed batch ({n}) must be a multiple of the mesh's "
+            f'"{SEED_AXIS}" axis size ({axis})'
+        )
     return jax.device_put(seeds, seed_sharding(mesh))
 
 

@@ -305,3 +305,18 @@ def test_run_stream_abandons_livelocked_lanes():
     assert out["completed"] >= 8
     assert len(out["abandoned"]) >= 8
     assert out["failing"] == []
+
+
+def test_run_stream_sharded_over_mesh(raft_engine):
+    cpus = jax.devices("cpu")
+    if len(cpus) < 2:
+        pytest.skip("no multi-device CPU backend")
+    mesh = make_mesh(cpus)
+    sharded = raft_engine.run_stream(
+        32, batch=8 * len(cpus), segment_steps=192, seed_start=900, mesh=mesh
+    )
+    unsharded = raft_engine.run_stream(
+        32, batch=8 * len(cpus), segment_steps=192, seed_start=900
+    )
+    assert sharded == unsharded  # sharding never changes results
+    assert sharded["completed"] >= 32
