@@ -197,3 +197,26 @@ def test_fs_namespace_crash_consistency():
     out = Runtime(seed=1).block_on(main())
     assert out["ghost"] is False  # unsynced creation did not survive
     assert out["durable"] == b"keep"  # unsynced unlink was rolled back
+
+
+def test_builder_config_file_env(monkeypatch, tmp_path):
+    # MADSIM_TEST_CONFIG loads a TOML Config (reference: builder.rs:85-93)
+    cfg_file = tmp_path / "sim.toml"
+    cfg_file.write_text(
+        "[net]\npacket_loss_rate = 0.25\n"
+        "send_latency_min_ns = 2000000\nsend_latency_max_ns = 3000000\n"
+    )
+    monkeypatch.setenv("MADSIM_TEST_CONFIG", str(cfg_file))
+    b = Builder.from_env()
+    assert b.config.net.packet_loss_rate == 0.25
+    assert b.config.net.send_latency_min_ns == 2_000_000
+
+    # the loaded config actually shapes the simulation: stable hash differs
+    from madsim_tpu.config import Config
+
+    assert b.config.stable_hash() != Config().stable_hash()
+
+    # and a bad config raises
+    cfg_file.write_text("[net]\npacket_loss_rate = 2.5\n")
+    with pytest.raises(ValueError):
+        Builder.from_env()
