@@ -29,3 +29,12 @@ def test_chaos_pipeline_example_deterministic():
     assert r1.returncode == 0, r1.stderr[-500:]
     assert r1.stdout == r2.stdout
     assert "evt-after-crash" in r1.stdout
+
+
+def test_bug_hunt_example():
+    r = _run("bug_hunt.py")
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "invariant violations" in r.stdout
+    assert "failed=True" in r.stdout
+    assert ("traces diverge at step" in r.stdout
+            or "no passing seed" in r.stdout)
