@@ -16,6 +16,20 @@ import threading
 
 _REEXEC_FLAG = "_MADSIM_TPU_BACKEND_REEXEC"
 _OK_FLAG = "_MADSIM_TPU_BACKEND_OK"
+_PLUGIN_GATE = "PALLAS_AXON_POOL_IPS"  # sitecustomize registers the TPU plugin iff set
+
+
+def clean_cpu_env(n_devices: int | None = None) -> dict:
+    """A copy of os.environ with the accelerator plugin gate unset and jax
+    forced onto the CPU backend (optionally with `n_devices` virtual host
+    devices). Single source of truth for the gate/flag knob names."""
+    env = dict(os.environ)
+    env.pop(_PLUGIN_GATE, None)
+    env.pop(_OK_FLAG, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    return env
 
 
 def ensure_live_backend(timeout_s: float = 120.0, argv=None) -> None:
@@ -42,9 +56,7 @@ def ensure_live_backend(timeout_s: float = 120.0, argv=None) -> None:
     t.start()
     t.join(timeout=timeout_s)
     if t.is_alive() or "error" in result:
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
+        env = clean_cpu_env()
         env[_REEXEC_FLAG] = "1"
         cause = result.get("error", f"device init hung >{timeout_s:.0f}s")
         print(
@@ -53,6 +65,17 @@ def ensure_live_backend(timeout_s: float = 120.0, argv=None) -> None:
             file=sys.stderr,
             flush=True,
         )
-        os.execve(sys.executable, [sys.executable] + (argv or sys.argv), env)
+        cmdline = argv or sys.argv
+        if not argv and cmdline and cmdline[0] in ("-c", "-m"):
+            # `python -c`/`-m` invocations: the code string / module args are
+            # not recoverable from sys.argv, so a re-exec would replay a
+            # broken command line. Fail with the recipe instead.
+            raise RuntimeError(
+                f"accelerator backend unavailable ({cause}) and the process "
+                f"cannot be re-exec'd (launched via `python {cmdline[0]}`). "
+                f"Re-run with: env -u {_PLUGIN_GATE} JAX_PLATFORMS=cpu "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count=8 python ..."
+            )
+        os.execve(sys.executable, [sys.executable] + cmdline, env)
     # healthy: remember so later calls (and children) skip the probe
     os.environ[_OK_FLAG] = "1"

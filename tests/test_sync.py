@@ -255,8 +255,10 @@ def test_select_and_joinset():
         rt = tokio.runtime.Builder.new_multi_thread().enable_all().build()
         h = rt.spawn(fast())
         assert await h == "fast"
+        never_run = fast()
         with pytest.raises(NotImplementedError):
-            rt.block_on(fast())
+            rt.block_on(never_run)
+        never_run.close()  # block_on refused it; silence the un-awaited warning
         return True
 
     assert run(main)
