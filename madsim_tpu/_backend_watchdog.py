@@ -66,10 +66,13 @@ def ensure_live_backend(timeout_s: float = 120.0, argv=None) -> None:
             flush=True,
         )
         cmdline = argv or sys.argv
-        if not argv and cmdline and cmdline[0] in ("-c", "-m"):
-            # `python -c`/`-m` invocations: the code string / module args are
-            # not recoverable from sys.argv, so a re-exec would replay a
-            # broken command line. Fail with the recipe instead.
+        unrecoverable = cmdline and (
+            cmdline[0] == "-c"  # code string not in sys.argv
+            # `python -m pkg` leaves the package's __main__.py path in
+            # argv[0]; re-running it as a script breaks relative imports
+            or os.path.basename(cmdline[0]) == "__main__.py"
+        )
+        if not argv and unrecoverable:
             raise RuntimeError(
                 f"accelerator backend unavailable ({cause}) and the process "
                 f"cannot be re-exec'd (launched via `python {cmdline[0]}`). "

@@ -109,6 +109,26 @@ class LaneState:
 
 
 @struct.dataclass
+class StreamCarry:
+    """Device-resident streaming state: lanes + seed counter + result
+    rings. Everything run_stream needs per segment lives on-device; the
+    host fetches only `counters` (one small uint32[5] transfer) and
+    drains the rings when they near capacity."""
+
+    state: LaneState
+    seeds: jax.Array  # uint32[L] — seed currently owned by each lane
+    done: jax.Array  # bool[L] — harvest mask; refilled at next segment start
+    next_seed: jax.Array  # uint32 scalar
+    completed: jax.Array  # int32 scalar
+    fail_seeds: jax.Array  # uint32[C]
+    fail_codes: jax.Array  # int32[C]
+    fail_count: jax.Array  # int32 scalar
+    ab_seeds: jax.Array  # uint32[C]
+    ab_count: jax.Array  # int32 scalar
+    counters: jax.Array  # uint32[5]: completed, fail_count, ab_count, next_seed, flags
+
+
+@struct.dataclass
 class BatchResult:
     seeds: jax.Array
     done: jax.Array
@@ -419,24 +439,124 @@ class Engine:
         final, _ = lax.while_loop(cond, body, (state, jnp.int32(0)))
         return final
 
-    def _stream_fns(self, segment_steps: int):
-        """Jitted building blocks for run_stream, cached per segment size
-        (fresh jit wrappers would recompile on every call)."""
+    def _stream_fns(self, segment_steps: int, max_steps: int, ring_capacity: int):
+        """Jitted building blocks for run_stream, cached per shape-affecting
+        params (fresh jit wrappers would recompile on every call)."""
         cache = getattr(self, "_stream_cache", None)
         if cache is None:
             cache = self._stream_cache = {}
-        if segment_steps not in cache:
-            init = jax.jit(self.init_batch)
-            seg = jax.jit(partial(self.run_segment, segment_steps=segment_steps))
+        key = (segment_steps, max_steps, ring_capacity)
+        if key in cache:
+            return cache[key]
 
-            def _refill(state, fresh, done, seeds, fresh_seeds):
+        cap = ring_capacity
+
+        def _append_ring(buf, count, mask, values):
+            """Scatter-free ordered append: masked lane k (in lane order)
+            lands at ring slot count+rank(k). One-hot compare + max-combine
+            (at most one lane matches a slot); entries past capacity are
+            dropped — the host's drain policy makes that unreachable."""
+            ranks = jnp.cumsum(mask.astype(jnp.int32)) - 1
+            tgt = jnp.where(mask, count + ranks, jnp.int32(-1))
+            onehot = tgt[None, :] == jnp.arange(cap, dtype=jnp.int32)[:, None]
+            # dtype-min fill so max-combine is value-preserving even for
+            # negative user fail codes (the invariant API is an open int32)
+            fill = jnp.array(jnp.iinfo(values.dtype).min, values.dtype)
+            newv = jnp.max(jnp.where(onehot, values[None, :], fill), axis=1)
+            buf = jnp.where(onehot.any(axis=1), newv, buf)
+            return buf, count + mask.sum(dtype=jnp.int32)
+
+        def _counters(c: StreamCarry) -> jax.Array:
+            over = (c.fail_count > cap) | (c.ab_count > cap)
+            return jnp.stack(
+                [
+                    c.completed.astype(jnp.uint32),
+                    c.fail_count.astype(jnp.uint32),
+                    c.ab_count.astype(jnp.uint32),
+                    c.next_seed,
+                    over.astype(jnp.uint32),
+                ]
+            )
+
+        def init_carry(seeds) -> StreamCarry:
+            batch = seeds.shape[0]
+            c = StreamCarry(
+                state=self.init_batch(seeds),
+                seeds=seeds,
+                done=jnp.zeros((batch,), bool),
+                next_seed=seeds[-1] + jnp.uint32(1),
+                completed=jnp.int32(0),
+                fail_seeds=jnp.zeros((cap,), jnp.uint32),
+                fail_codes=jnp.zeros((cap,), jnp.int32),
+                fail_count=jnp.int32(0),
+                ab_seeds=jnp.zeros((cap,), jnp.uint32),
+                ab_count=jnp.int32(0),
+                counters=jnp.zeros((5,), jnp.uint32),
+            )
+            return c.replace(counters=_counters(c))
+
+        def segment(c: StreamCarry) -> StreamCarry:
+            # 1. refill lanes harvested at the end of the previous segment
+            #    (device-side ranks + seed counter: gapless, in lane order)
+            n_refill = c.done.sum(dtype=jnp.int32)
+
+            def do_refill(_):
+                ranks = jnp.cumsum(c.done.astype(jnp.int32)) - 1
+                fresh_seeds = c.next_seed + ranks.astype(jnp.uint32)
+                fresh = self.init_batch(fresh_seeds)
                 return (
-                    tree_where(done, fresh, state),
-                    jnp.where(done, fresh_seeds, seeds),
+                    tree_where(c.done, fresh, c.state),
+                    jnp.where(c.done, fresh_seeds, c.seeds),
+                    c.next_seed + n_refill.astype(jnp.uint32),
                 )
 
-            cache[segment_steps] = (init, seg, jax.jit(_refill))
-        return cache[segment_steps]
+            state, seeds, next_seed = lax.cond(
+                n_refill > 0,
+                do_refill,
+                lambda _: (c.state, c.seeds, c.next_seed),
+                None,
+            )
+
+            # 2. advance the batch one segment
+            state = self.run_segment(state, segment_steps)
+
+            # 3. harvest on-device: count completions, ring-append failing
+            #    seeds/codes and abandoned (over-cap) seeds
+            over_cap = state.step >= max_steps
+            done = state.done | state.failed | over_cap
+            completed = c.completed + done.sum(dtype=jnp.int32)
+            fail_mask = done & state.failed
+            fail_seeds, fail_count = _append_ring(
+                c.fail_seeds, c.fail_count, fail_mask, seeds
+            )
+            fail_codes, _ = _append_ring(
+                c.fail_codes, c.fail_count, fail_mask, state.fail_code
+            )
+            ab_mask = done & ~state.failed & over_cap
+            ab_seeds, ab_count = _append_ring(c.ab_seeds, c.ab_count, ab_mask, seeds)
+
+            new = StreamCarry(
+                state=state,
+                seeds=seeds,
+                done=done,
+                next_seed=next_seed,
+                completed=completed,
+                fail_seeds=fail_seeds,
+                fail_codes=fail_codes,
+                fail_count=fail_count,
+                ab_seeds=ab_seeds,
+                ab_count=ab_count,
+                counters=c.counters,
+            )
+            return new.replace(counters=_counters(new))
+
+        def reset_rings(c: StreamCarry) -> StreamCarry:
+            new = c.replace(fail_count=jnp.int32(0), ab_count=jnp.int32(0))
+            return new.replace(counters=_counters(new))
+
+        fns = (jax.jit(init_carry), jax.jit(segment), jax.jit(reset_rings))
+        cache[key] = fns
+        return fns
 
     def run_stream(
         self,
@@ -448,76 +568,83 @@ class Engine:
         mesh=None,
     ):
         """Continuous seed streaming: run at least n_seeds simulations
-        keeping every lane busy. After each segment, finished lanes are
-        harvested and refilled with fresh seeds, so stragglers never idle
-        the batch (with per-lane step counts varying 10x, this beats
-        `run_batch` by the same factor at scale).
+        keeping every lane busy. Each segment is ONE fused jitted call —
+        refill previously-finished lanes with fresh seeds (device-side
+        cumsum ranks + a device-resident next-seed counter), advance
+        `segment_steps` events, then harvest completions into on-device
+        result rings. The host fetches a single small counters array per
+        segment and drains the failing/abandoned rings only when they
+        near capacity — no per-lane host round trips, so streaming scales
+        on a real chip instead of serializing device<->host every segment.
 
         Seed coverage is gapless: exactly the range
-        [seed_start, seed_start + seeds_consumed) enters lanes, in order
-        (done lanes take the next consecutive seeds via a cumsum rank).
+        [seed_start, seed_start + seeds_consumed) enters lanes, in order.
         Lanes exceeding `max_steps` events are abandoned and reported.
 
         With `mesh`, the lane axis shards over the mesh's "seeds" axis and
-        every streaming op (init / segment / refill) stays sharded by
-        propagation — the 100k-seeds-over-a-pod configuration.
+        every streaming op (init / segment / refill / ring append) stays
+        sharded by propagation — the 100k-seeds-over-a-pod configuration.
 
         Returns {"completed", "failing": [(seed, code)...],
         "abandoned": [seed...], "seeds_consumed"}.
         """
         import numpy as np
 
-        init, seg, refill = self._stream_fns(segment_steps)
+        # Ring capacity: drains trigger at cap - batch, so one segment
+        # (which can complete at most `batch` lanes) can never overflow.
+        ring_capacity = 2 * batch
+        init_carry, segment, reset_rings = self._stream_fns(
+            segment_steps, max_steps, ring_capacity
+        )
 
-        next_seed = seed_start
-        seeds = jnp.arange(next_seed, next_seed + batch, dtype=jnp.uint32)
+        seeds = jnp.arange(seed_start, seed_start + batch, dtype=jnp.uint32)
         if mesh is not None:
             from ..parallel import shard_seeds
 
             seeds = shard_seeds(seeds, mesh)  # validates mesh axis + batch
-        next_seed += batch
-        state = init(seeds)
-        completed = 0
+        carry = init_carry(seeds)
+
         failing: list = []
         abandoned: list = []
+
+        def drain(c: StreamCarry) -> StreamCarry:
+            f_seeds, f_codes, f_n, a_seeds, a_n = jax.device_get(
+                (c.fail_seeds, c.fail_codes, c.fail_count, c.ab_seeds, c.ab_count)
+            )
+            failing.extend(
+                (int(s), int(code))
+                for s, code in zip(f_seeds[: int(f_n)], f_codes[: int(f_n)])
+            )
+            abandoned.extend(int(s) for s in a_seeds[: int(a_n)])
+            return reset_rings(c)
+
+        completed = 0
         segments = 0
         # hard ceiling well above the expected segment count (progress is
         # guaranteed because over-cap lanes are abandoned at harvest)
         max_segments = (max_steps // segment_steps + 2) * (n_seeds // batch + 2)
         while completed < n_seeds and segments < max_segments:
-            state = seg(state)
+            carry = segment(carry)
             segments += 1
-            over_cap = state.step >= max_steps
-            done = state.done | state.failed | over_cap
-            done_np = np.asarray(jax.device_get(done))
-            n_done = int(done_np.sum())
-            if not n_done:
-                continue
-            seeds_np = np.asarray(jax.device_get(seeds))
-            failed_np = np.asarray(jax.device_get(state.failed))
-            hit = np.flatnonzero(done_np & failed_np)
-            if hit.size:
-                codes_np = np.asarray(jax.device_get(state.fail_code))
-                failing.extend(
-                    (int(seeds_np[i]), int(codes_np[i])) for i in hit
+            # the one device<->host transfer of the steady-state loop
+            counters = np.asarray(jax.device_get(carry.counters))
+            completed = int(counters[0])
+            if counters[4]:
+                raise RuntimeError(
+                    "run_stream result ring overflowed (drain policy bug)"
                 )
-            over_np = np.asarray(jax.device_get(over_cap)) & done_np & ~failed_np
-            abandoned.extend(int(seeds_np[i]) for i in np.flatnonzero(over_np))
-            completed += n_done
-            if completed >= n_seeds:
-                break  # target reached: don't start seeds that won't run
-            # gapless refill: done lane k (in lane order) gets seed
-            # next_seed + rank(k); only n_done seed values are consumed
-            ranks = jnp.cumsum(done.astype(jnp.int32)) - 1
-            fresh_seeds = (jnp.uint32(next_seed) + ranks.astype(jnp.uint32))
-            next_seed += n_done
-            fresh = init(fresh_seeds)
-            state, seeds = refill(state, fresh, done, seeds, fresh_seeds)
+            if (
+                int(counters[1]) > ring_capacity - batch
+                or int(counters[2]) > ring_capacity - batch
+            ):
+                carry = drain(carry)
+        carry = drain(carry)
+        counters = np.asarray(jax.device_get(carry.counters))
         return {
-            "completed": completed,
+            "completed": int(counters[0]),
             "failing": failing,
             "abandoned": abandoned,
-            "seeds_consumed": next_seed - seed_start,
+            "seeds_consumed": int(counters[3]) - seed_start,
         }
 
     def make_runner(self, max_steps: int = 10_000, mesh=None):
