@@ -68,9 +68,12 @@ def ensure_live_backend(timeout_s: float = 120.0, argv=None) -> None:
         cmdline = argv or sys.argv
         unrecoverable = cmdline and (
             cmdline[0] == "-c"  # code string not in sys.argv
-            # `python -m pkg` leaves the package's __main__.py path in
-            # argv[0]; re-running it as a script breaks relative imports
-            or os.path.basename(cmdline[0]) == "__main__.py"
+            # `python -m pkg[.mod]` leaves the module's file path in
+            # argv[0]; re-running a file that lives inside a package as a
+            # plain script breaks its relative imports
+            or os.path.exists(
+                os.path.join(os.path.dirname(cmdline[0]) or ".", "__init__.py")
+            )
         )
         if not argv and unrecoverable:
             raise RuntimeError(

@@ -452,19 +452,21 @@ class Engine:
         cap = ring_capacity
 
         def _append_ring(buf, count, mask, values):
-            """Scatter-free ordered append: masked lane k (in lane order)
-            lands at ring slot count+rank(k). One-hot compare + max-combine
-            (at most one lane matches a slot); entries past capacity are
-            dropped — the host's drain policy makes that unreachable."""
-            ranks = jnp.cumsum(mask.astype(jnp.int32)) - 1
-            tgt = jnp.where(mask, count + ranks, jnp.int32(-1))
-            onehot = tgt[None, :] == jnp.arange(cap, dtype=jnp.int32)[:, None]
-            # dtype-min fill so max-combine is value-preserving even for
-            # negative user fail codes (the invariant API is an open int32)
-            fill = jnp.array(jnp.iinfo(values.dtype).min, values.dtype)
-            newv = jnp.max(jnp.where(onehot, values[None, :], fill), axis=1)
-            buf = jnp.where(onehot.any(axis=1), newv, buf)
-            return buf, count + mask.sum(dtype=jnp.int32)
+            """Scatter-free ordered append: masked lane of rank r (in lane
+            order) lands at ring slot count+r. Inverted as a gather — slot
+            j's source lane is the first lane whose inclusive cumsum equals
+            j-count+1 (searchsorted: O(cap log L), vs O(cap*L) for a
+            one-hot matrix) — so it stays cheap at pod-scale batches.
+            Entries past capacity are dropped; the host's drain policy
+            makes that unreachable."""
+            csum = jnp.cumsum(mask.astype(jnp.int32))  # [L], rank+1 at masked lanes
+            n_new = csum[-1]
+            want_rank = jnp.arange(cap, dtype=jnp.int32) - count + 1  # 1-based
+            src = jnp.searchsorted(csum, want_rank, side="left").astype(jnp.int32)
+            fills = (want_rank >= 1) & (want_rank <= n_new)
+            vals = values[jnp.clip(src, 0, mask.shape[0] - 1)]
+            buf = jnp.where(fills, vals, buf)
+            return buf, count + n_new
 
         def _counters(c: StreamCarry) -> jax.Array:
             over = (c.fail_count > cap) | (c.ab_count > cap)

@@ -21,6 +21,7 @@ import functools
 import os
 import sys
 import threading
+import warnings
 from typing import Any, Callable, Coroutine, List, Optional
 
 from ..config import Config
@@ -75,20 +76,140 @@ class Builder:
         return rt.block_on(factory())
 
     def run(self, factory: Callable[[], Coroutine]) -> Any:
-        """Run `count` seeds, `jobs` at a time, each runtime on its own
-        thread (reference: builder.rs:121-160). Returns the last result."""
+        """Run `count` seeds, `jobs` at a time. Returns the result of the
+        last seed.
+
+        Parallelism is real: each concurrent seed gets its own OS
+        *process* (reference runs one runtime per OS thread,
+        builder.rs:121-160 — genuinely parallel in Rust; Python threads
+        would serialize CPU-bound sims on the GIL, so `fork` is the
+        faithful equivalent). Falls back to threads where fork is
+        unavailable."""
         seeds = list(range(self.seed, self.seed + self.count))
         result: Any = None
         if self.jobs <= 1:
             for seed in seeds:
                 result = self._run_in_thread(seed, factory)
             return result
+        # fork only on linux: macOS fork() is unsafe once threads/frameworks
+        # are up (CPython's own default there is spawn for this reason)
+        if sys.platform.startswith("linux"):
+            return self._run_parallel_processes(seeds, factory)
+        return self._run_parallel_threads(seeds, factory)
+
+    def _run_parallel_processes(
+        self, seeds: List[int], factory: Callable[[], Coroutine]
+    ) -> Any:
+        """fork one child per seed, at most `jobs` alive at once. The
+        factory closure and `self` are inherited through fork (no
+        pickling of the workload); only results/errors cross the pipe."""
+        import multiprocessing as mp
+        import pickle
+        import traceback
+        from queue import Empty
+
+        ctx = mp.get_context("fork")
+        queue: Any = ctx.Queue()
+
+        def child(seed: int) -> None:
+            code = 0
+            try:
+                value = self._run_one(seed, factory)
+                try:
+                    pickle.dumps(value)
+                except Exception:  # unpicklable result: drop the value only
+                    value = None
+                queue.put((seed, None, value))
+            except BaseException:  # noqa: BLE001
+                queue.put((seed, traceback.format_exc(), None))
+                code = 1
+            # flush the queue's feeder thread BEFORE the hard exit, or the
+            # result can die buffered in the child
+            queue.close()
+            queue.join_thread()
+            # _exit skips atexit hooks (forked jax/XLA teardown can hang)
+            os._exit(code)
+
+        pending = list(seeds)
+        procs: dict[int, Any] = {}
+        last_result: List[Any] = [None]
+        errors: dict[int, str] = {}
+
+        def launch_up_to_jobs() -> None:
+            while pending and len(procs) < self.jobs:
+                seed = pending.pop(0)
+                p = ctx.Process(target=child, args=(seed,), name=f"madsim-seed-{seed}")
+                with warnings.catch_warnings():
+                    # CPython warns that forking a multi-threaded process
+                    # (jax's pools) can deadlock the child. Children here
+                    # run only the pure-Python/C++ host sim — never jax —
+                    # and leave via os._exit, so inherited jax locks are
+                    # never acquired.
+                    warnings.simplefilter("ignore", DeprecationWarning)
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    p.start()
+                procs[seed] = p
+
+        def record(seed: int, err: Any, value: Any) -> None:
+            if err is None:
+                if seed == seeds[-1]:
+                    last_result[0] = value
+            else:
+                errors[seed] = err
+            p = procs.pop(seed, None)
+            if p is not None:
+                p.join()
+
+        launch_up_to_jobs()
+        while procs:
+            try:
+                record(*queue.get(timeout=0.5))
+            except Empty:
+                # a message can still be in flight for a child that already
+                # exited — drain everything available before declaring any
+                # dead child result-less
+                while True:
+                    try:
+                        record(*queue.get_nowait())
+                    except Empty:
+                        break
+                for seed, p in list(procs.items()):
+                    if not p.is_alive():
+                        p.join()
+                        errors[seed] = (
+                            f"simulation process died (exit code {p.exitcode}) "
+                            f"without reporting a result"
+                        )
+                        del procs[seed]
+            launch_up_to_jobs()
+
+        if errors:
+            for seed in sorted(errors):
+                print(
+                    f"note: run with `MADSIM_TEST_SEED={seed}` environment "
+                    f"variable to reproduce this failure",
+                    file=sys.stderr,
+                )
+            first = min(errors)
+            raise RuntimeError(
+                f"seed {first} failed:\n{errors[first]}"
+                + (f"\n({len(errors)} seeds failed in total)" if len(errors) > 1 else "")
+            )
+        return last_result[0]
+
+    def _run_parallel_threads(
+        self, seeds: List[int], factory: Callable[[], Coroutine]
+    ) -> Any:
+        """Thread fallback for platforms without safe fork (GIL-serialized)."""
+        last_result: Any = None
         with concurrent.futures.ThreadPoolExecutor(max_workers=self.jobs) as pool:
             futs = {pool.submit(self._run_one, seed, factory): seed for seed in seeds}
             for fut in concurrent.futures.as_completed(futs):
                 seed = futs[fut]
                 try:
-                    result = fut.result()
+                    value = fut.result()
+                    if seed == seeds[-1]:
+                        last_result = value
                 except BaseException:
                     print(
                         f"note: run with `MADSIM_TEST_SEED={seed}` environment "
@@ -96,7 +217,7 @@ class Builder:
                         file=sys.stderr,
                     )
                     raise
-        return result
+        return last_result
 
     def _run_in_thread(self, seed: int, factory: Callable[[], Coroutine]) -> Any:
         """One runtime per fresh thread, like the reference harness."""
