@@ -42,33 +42,46 @@ def try_current() -> Optional[SimContext]:
     return getattr(_tls, "ctx", None)
 
 
+def _not_in_sim() -> RuntimeError:
+    return RuntimeError(
+        "this API must be called from within a madsim_tpu simulation "
+        "(inside `Runtime().block_on(...)`)"
+    )
+
+
 def current() -> SimContext:
-    ctx = try_current()
+    ctx = getattr(_tls, "ctx", None)
     if ctx is None:
-        raise RuntimeError(
-            "this API must be called from within a madsim_tpu simulation "
-            "(inside `Runtime().block_on(...)`)"
-        )
+        raise _not_in_sim()
     return ctx
 
 
 def current_rng() -> "GlobalRng":
-    return current().executor.rng
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        raise _not_in_sim()
+    return ctx.executor.rng
 
 
 def current_time() -> "TimeHandle":
-    return current().executor.time
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        raise _not_in_sim()
+    return ctx.executor.time
 
 
 def try_time_ns() -> Optional[int]:
-    ctx = try_current()
+    ctx = getattr(_tls, "ctx", None)
     if ctx is None:
         return None
     return ctx.executor.time.now_ns()
 
 
 def current_task() -> "TaskEntry":
-    task = current().current_task
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        raise _not_in_sim()
+    task = ctx.current_task
     if task is None:
         raise RuntimeError("this API must be called from within a spawned task")
     return task

@@ -1,121 +1,101 @@
-"""Native core loader — builds simcore.cc with g++ on first use.
+"""Native core loader — builds hostcore.cc (a CPython extension) with g++
+on first use.
 
 The reference runtime is native Rust; here the host engine's hot inner
-loops (bulk Philox generation, the timer heap) run in C++ via ctypes.
+loops (Philox RNG, the virtual clock + timer heap, and the executor's
+random-order poll loop) run in C++ as a real extension module — method
+calls cost nanoseconds, not the microseconds of a ctypes round trip.
 Everything degrades to pure Python with identical semantics when no
-toolchain is available (`MADSIM_TPU_NO_NATIVE=1` forces the fallback).
+toolchain is available (`MADSIM_TPU_NO_NATIVE=1` forces the fallback);
+bit-identity between the two paths is asserted by tests/test_native.py.
 """
 
 from __future__ import annotations
 
-import ctypes
 import hashlib
+import importlib.machinery
+import importlib.util
 import os
 import subprocess
-from typing import List, Optional, Tuple
+import sysconfig
+from typing import Any, List, Optional
 
 _HERE = os.path.dirname(__file__)
-_SRC = os.path.join(_HERE, "simcore.cc")
+_SRC = os.path.join(_HERE, "hostcore.cc")
 
-_lib: Optional[ctypes.CDLL] = None
+_mod: Optional[Any] = None
 _tried = False
 
 
-def _build_and_load() -> Optional[ctypes.CDLL]:
+def _build_and_load() -> Optional[Any]:
     if os.environ.get("MADSIM_TPU_NO_NATIVE"):
         return None
     try:
         with open(_SRC, "rb") as f:
             digest = hashlib.sha256(f.read()).hexdigest()[:16]
-        so_path = os.path.join(_HERE, f"simcore-{digest}.so")
+        # key the cache by the interpreter ABI too — the extension links
+        # against Python.h internals, so a stale .so from another Python
+        # version must trigger a rebuild, not a segfault
+        abi = sysconfig.get_config_var("SOABI") or "abi3"
+        so_path = os.path.join(_HERE, f"hostcore-{digest}-{abi}.so")
         if not os.path.exists(so_path):
             tmp = f"{so_path}.{os.getpid()}.tmp"  # unique: concurrent builders don't clobber
+            include = sysconfig.get_paths()["include"]
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+                [
+                    "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                    f"-I{include}", "-o", tmp, _SRC,
+                ],
                 check=True,
                 capture_output=True,
             )
             os.replace(tmp, so_path)
-        lib = ctypes.CDLL(so_path)
-        lib.philox_fill.argtypes = [
-            ctypes.c_uint32,
-            ctypes.c_uint32,
-            ctypes.c_uint64,
-            ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_uint32),
-        ]
-        lib.timer_new.restype = ctypes.c_void_p
-        lib.timer_free.argtypes = [ctypes.c_void_p]
-        lib.timer_push.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
-        lib.timer_pop.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_uint64),
-        ]
-        lib.timer_pop.restype = ctypes.c_int
-        lib.timer_peek.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
-        lib.timer_peek.restype = ctypes.c_int
-        lib.timer_len.argtypes = [ctypes.c_void_p]
-        lib.timer_len.restype = ctypes.c_uint64
-        return lib
+        loader = importlib.machinery.ExtensionFileLoader("hostcore", so_path)
+        spec = importlib.util.spec_from_file_location("hostcore", so_path, loader=loader)
+        assert spec is not None
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        return mod
     except Exception:  # noqa: BLE001 - no toolchain / build failure: fall back
         return None
 
 
-def get_lib() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
+def get_mod() -> Optional[Any]:
+    global _mod, _tried
     if not _tried:
-        _lib = _build_and_load()
+        _mod = _build_and_load()
         _tried = True
-    return _lib
+    return _mod
 
 
 def available() -> bool:
-    return get_lib() is not None
+    return get_mod() is not None
 
 
 def philox_fill(k0: int, k1: int, start_block: int, nblocks: int) -> List[int]:
     """nblocks philox blocks as a flat list of 4*nblocks uint32 words —
     bit-identical to repeated rand/philox.py `philox4x32` calls."""
-    lib = get_lib()
-    assert lib is not None
-    buf = (ctypes.c_uint32 * (4 * nblocks))()
-    lib.philox_fill(k0, k1, start_block, nblocks, buf)
-    return list(buf)
+    mod = get_mod()
+    assert mod is not None
+    return mod.philox_fill(k0, k1, start_block, nblocks)
 
 
-class NativeTimerHeap:
-    """(deadline, seq)-ordered timer heap with integer ids; the Python
-    side keeps id -> callback."""
+def make_rng(k0: int, k1: int):
+    """A native buffered Philox draw stream (see hostcore.Rng)."""
+    mod = get_mod()
+    assert mod is not None
+    return mod.Rng(k0, k1)
 
-    __slots__ = ("_lib", "_h")
 
-    def __init__(self) -> None:
-        self._lib = get_lib()
-        assert self._lib is not None
-        self._h = self._lib.timer_new()
+def make_time_core():
+    """The native virtual clock + timer heap (see hostcore.TimeCore)."""
+    mod = get_mod()
+    assert mod is not None
+    return mod.TimeCore()
 
-    def push(self, deadline: int, seq: int) -> None:
-        self._lib.timer_push(self._h, deadline, seq)
 
-    def pop(self) -> Optional[Tuple[int, int]]:
-        """(deadline, seq) of the earliest timer, or None."""
-        deadline = ctypes.c_int64()
-        seq = ctypes.c_uint64()
-        if not self._lib.timer_pop(self._h, ctypes.byref(deadline), ctypes.byref(seq)):
-            return None
-        return deadline.value, seq.value
-
-    def peek_deadline(self) -> Optional[int]:
-        deadline = ctypes.c_int64()
-        if not self._lib.timer_peek(self._h, ctypes.byref(deadline)):
-            return None
-        return deadline.value
-
-    def __len__(self) -> int:
-        return self._lib.timer_len(self._h)
-
-    def __del__(self) -> None:  # noqa: D105 - freeing native memory only
-        lib = getattr(self, "_lib", None)
-        if lib is not None:
-            lib.timer_free(self._h)
+def run_all_ready(executor, ctx, rng_core, time_core) -> None:
+    """The native executor poll loop (see hostcore.run_all_ready)."""
+    mod = get_mod()
+    assert mod is not None
+    mod.run_all_ready(executor, ctx, rng_core, time_core)

@@ -1,0 +1,1130 @@
+// hostcore — native hot-path core for the host engine (CPython extension).
+//
+// The reference's runtime is native Rust end-to-end (madsim/src/sim/task/
+// mod.rs:220-323 is a compiled poll loop over compiled futures). Python
+// coroutines can't be compiled away, but everything AROUND them can; this
+// extension keeps the host engine's inner loops native:
+//
+//   * Rng            — buffered Philox4x32-10 draws (bit-identical to
+//                      madsim_tpu/rand/philox.py, asserted in tests)
+//   * TimeCore       — the virtual clock + (deadline, seq)-ordered timer
+//                      heap with PyObject callbacks (sim/time/mod.rs:45-59)
+//   * run_all_ready  — the executor's drain-in-random-order poll loop
+//                      (sim/task/mod.rs:263-323 + utils/mpsc.rs:73-83),
+//                      including the 50-100 ns advance per poll
+//
+// Draw-sequence parity with the pure-Python executor loop is load-bearing:
+// the Python fallback (MADSIM_TPU_NO_NATIVE=1) and this loop consume RNG
+// draws in EXACTLY the same pattern (a pick draw only when >1 task is
+// ready; an advance draw after every effective poll), so a seed replays
+// bit-identically whichever loop ran it. The determinism log/check mode
+// routes through the Python loop (it must observe every draw).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Philox4x32-10 (same constants/recurrence as rand/philox.py)
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kPhiloxM0 = 0xD2511F53u;
+constexpr uint32_t kPhiloxM1 = 0xCD9E8D57u;
+constexpr uint32_t kPhiloxW0 = 0x9E3779B9u;
+constexpr uint32_t kPhiloxW1 = 0xBB67AE85u;
+
+inline void philox_block(uint32_t k0, uint32_t k1, uint32_t c0, uint32_t c1,
+                         uint32_t c2, uint32_t c3, uint32_t* out) {
+  for (int round = 0; round < 10; ++round) {
+    uint64_t p0 = static_cast<uint64_t>(kPhiloxM0) * c0;
+    uint64_t p1 = static_cast<uint64_t>(kPhiloxM1) * c2;
+    uint32_t hi0 = static_cast<uint32_t>(p0 >> 32);
+    uint32_t lo0 = static_cast<uint32_t>(p0);
+    uint32_t hi1 = static_cast<uint32_t>(p1 >> 32);
+    uint32_t lo1 = static_cast<uint32_t>(p1);
+    uint32_t n0 = hi1 ^ c1 ^ k0;
+    uint32_t n1 = lo1;
+    uint32_t n2 = hi0 ^ c3 ^ k1;
+    uint32_t n3 = lo0;
+    c0 = n0; c1 = n1; c2 = n2; c3 = n3;
+    k0 += kPhiloxW0;
+    k1 += kPhiloxW1;
+  }
+  out[0] = c0; out[1] = c1; out[2] = c2; out[3] = c3;
+}
+
+// ---------------------------------------------------------------------------
+// Rng — buffered philox word stream; word k == block(k/4)[k%4], identical
+// to GlobalRng's consumption order (rand/__init__.py:65-93)
+// ---------------------------------------------------------------------------
+
+constexpr int kBufBlocks = 64;
+constexpr int kBufWords = kBufBlocks * 4;
+
+struct RngObject {
+  PyObject_HEAD
+  uint32_t k0, k1;
+  uint64_t counter;  // next philox block index
+  int pos;           // next word in buf; kBufWords == empty
+  uint32_t buf[kBufWords];
+};
+
+inline uint32_t rng_u32(RngObject* r) {
+  if (r->pos >= kBufWords) {
+    for (int i = 0; i < kBufBlocks; ++i) {
+      uint64_t block = r->counter + i;
+      philox_block(r->k0, r->k1, static_cast<uint32_t>(block),
+                   static_cast<uint32_t>(block >> 32), 0u, 0u, r->buf + 4 * i);
+    }
+    r->counter += kBufBlocks;
+    r->pos = 0;
+  }
+  return r->buf[r->pos++];
+}
+
+inline uint64_t rng_u64(RngObject* r) {
+  uint64_t lo = rng_u32(r);
+  uint64_t hi = rng_u32(r);
+  return (hi << 32) | lo;
+}
+
+// gen_range semantics of rand/__init__.py:152-161: low + next_u64 % span.
+inline int64_t rng_range(RngObject* r, int64_t low, int64_t high) {
+  uint64_t span = static_cast<uint64_t>(high - low);
+  return low + static_cast<int64_t>(rng_u64(r) % span);
+}
+
+static PyObject* Rng_new(PyTypeObject* type, PyObject* args, PyObject* kwds) {
+  unsigned long k0 = 0, k1 = 0;
+  unsigned long long counter = 0;
+  static const char* kwlist[] = {"k0", "k1", "counter", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "kk|K",
+                                   const_cast<char**>(kwlist), &k0, &k1,
+                                   &counter)) {
+    return nullptr;
+  }
+  RngObject* self = reinterpret_cast<RngObject*>(type->tp_alloc(type, 0));
+  if (!self) return nullptr;
+  self->k0 = static_cast<uint32_t>(k0);
+  self->k1 = static_cast<uint32_t>(k1);
+  self->counter = counter;
+  self->pos = kBufWords;
+  return reinterpret_cast<PyObject*>(self);
+}
+
+static PyObject* Rng_next_u32(PyObject* self, PyObject*) {
+  return PyLong_FromUnsignedLong(rng_u32(reinterpret_cast<RngObject*>(self)));
+}
+
+static PyObject* Rng_next_u64(PyObject* self, PyObject*) {
+  return PyLong_FromUnsignedLongLong(rng_u64(reinterpret_cast<RngObject*>(self)));
+}
+
+static PyObject* Rng_gen_range(PyObject* self, PyObject* args) {
+  long long low, high;
+  if (!PyArg_ParseTuple(args, "LL", &low, &high)) return nullptr;
+  if (high <= low) {
+    PyErr_Format(PyExc_ValueError, "empty range [%lld, %lld)", low, high);
+    return nullptr;
+  }
+  return PyLong_FromLongLong(
+      rng_range(reinterpret_cast<RngObject*>(self), low, high));
+}
+
+static PyObject* Rng_random(PyObject* self, PyObject*) {
+  uint64_t v = rng_u64(reinterpret_cast<RngObject*>(self));
+  return PyFloat_FromDouble(static_cast<double>(v >> 11) *
+                            (1.0 / 9007199254740992.0));  // 2^-53
+}
+
+static PyObject* Rng_getstate(PyObject* self, PyObject*) {
+  RngObject* r = reinterpret_cast<RngObject*>(self);
+  // (block_counter, words_consumed_in_buffer) — enough to assert parity
+  int consumed = r->pos >= kBufWords ? 0 : r->pos;
+  uint64_t base = r->pos >= kBufWords ? r->counter : r->counter - kBufBlocks;
+  return Py_BuildValue("KK", base * 4 + static_cast<uint64_t>(consumed),
+                       r->counter);
+}
+
+static PyMethodDef Rng_methods[] = {
+    {"next_u32", Rng_next_u32, METH_NOARGS, "next uint32 draw"},
+    {"next_u64", Rng_next_u64, METH_NOARGS, "next uint64 draw (lo then hi)"},
+    {"gen_range", Rng_gen_range, METH_VARARGS, "uniform int in [low, high)"},
+    {"random", Rng_random, METH_NOARGS, "uniform float64 in [0,1), 53 bits"},
+    {"words_drawn", Rng_getstate, METH_NOARGS,
+     "(total words drawn, block counter) — for parity tests"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PyTypeObject RngType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "hostcore.Rng",            /* tp_name */
+    sizeof(RngObject),         /* tp_basicsize */
+};
+
+// ---------------------------------------------------------------------------
+// Interned attribute names (created at module init)
+// ---------------------------------------------------------------------------
+
+static PyObject* s_time_limit_hit;
+static PyObject* s_ready;
+static PyObject* s_scheduled;
+static PyObject* s_finished;
+static PyObject* s_kill_requested;
+static PyObject* s_node;
+static PyObject* s_coro;
+static PyObject* s_cell;
+static PyObject* s_killed;
+static PyObject* s_paused;
+static PyObject* s_paused_tasks;
+static PyObject* s_tasks;
+static PyObject* s_discard;
+static PyObject* s_set;
+static PyObject* s_close_priv;
+static PyObject* s_current_task;
+static PyObject* s_running_task;
+static PyObject* s_panic;
+static PyObject* s_handle_panic;
+
+// True/False attr check with error propagation; -1 on error.
+static int attr_truth(PyObject* obj, PyObject* name) {
+  PyObject* v = PyObject_GetAttr(obj, name);
+  if (!v) return -1;
+  int t = PyObject_IsTrue(v);
+  Py_DECREF(v);
+  return t;
+}
+
+// ---------------------------------------------------------------------------
+// TimeCore — virtual clock + (deadline, seq) min-heap of callbacks
+// ---------------------------------------------------------------------------
+
+struct TimerEnt {
+  int64_t deadline;
+  uint64_t seq;
+  PyObject* cb;
+};
+
+struct TimerCmp {
+  // std::*_heap are max-heaps; invert for earliest (deadline, seq) first.
+  bool operator()(const TimerEnt& a, const TimerEnt& b) const {
+    if (a.deadline != b.deadline) return a.deadline > b.deadline;
+    return a.seq > b.seq;
+  }
+};
+
+struct TimeCoreObject {
+  PyObject_HEAD
+  int64_t now_ns;
+  uint64_t seq;
+  std::vector<TimerEnt>* heap;
+};
+
+static PyObject* TimeCore_new(PyTypeObject* type, PyObject*, PyObject*) {
+  TimeCoreObject* self =
+      reinterpret_cast<TimeCoreObject*>(type->tp_alloc(type, 0));
+  if (!self) return nullptr;
+  self->now_ns = 0;
+  self->seq = 0;
+  self->heap = new std::vector<TimerEnt>();
+  return reinterpret_cast<PyObject*>(self);
+}
+
+static void TimeCore_dealloc(PyObject* self) {
+  TimeCoreObject* t = reinterpret_cast<TimeCoreObject*>(self);
+  PyObject_GC_UnTrack(self);
+  if (t->heap) {
+    for (TimerEnt& e : *t->heap) Py_XDECREF(e.cb);
+    delete t->heap;
+    t->heap = nullptr;
+  }
+  Py_TYPE(self)->tp_free(self);
+}
+
+// GC support: pending callbacks (wakers, closures over the executor) can
+// form cycles back through the runtime graph — gc must traverse them.
+static int TimeCore_traverse(PyObject* self, visitproc visit, void* arg) {
+  TimeCoreObject* t = reinterpret_cast<TimeCoreObject*>(self);
+  if (t->heap) {
+    for (TimerEnt& e : *t->heap) Py_VISIT(e.cb);
+  }
+  return 0;
+}
+
+static int TimeCore_clear_gc(PyObject* self) {
+  TimeCoreObject* t = reinterpret_cast<TimeCoreObject*>(self);
+  if (t->heap) {
+    for (TimerEnt& e : *t->heap) Py_CLEAR(e.cb);
+    t->heap->clear();
+  }
+  return 0;
+}
+
+static PyObject* TimeCore_now_ns(PyObject* self, PyObject*) {
+  return PyLong_FromLongLong(
+      reinterpret_cast<TimeCoreObject*>(self)->now_ns);
+}
+
+static PyObject* TimeCore_advance_ns(PyObject* self, PyObject* arg) {
+  long long d = PyLong_AsLongLong(arg);
+  if (d == -1 && PyErr_Occurred()) return nullptr;
+  reinterpret_cast<TimeCoreObject*>(self)->now_ns += d;
+  Py_RETURN_NONE;
+}
+
+static PyObject* TimeCore_push(PyObject* self, PyObject* args) {
+  long long deadline;
+  PyObject* cb;
+  if (!PyArg_ParseTuple(args, "LO", &deadline, &cb)) return nullptr;
+  TimeCoreObject* t = reinterpret_cast<TimeCoreObject*>(self);
+  Py_INCREF(cb);
+  t->heap->push_back(TimerEnt{deadline, ++t->seq, cb});
+  std::push_heap(t->heap->begin(), t->heap->end(), TimerCmp{});
+  Py_RETURN_NONE;
+}
+
+static PyObject* TimeCore_peek(PyObject* self, PyObject*) {
+  TimeCoreObject* t = reinterpret_cast<TimeCoreObject*>(self);
+  if (t->heap->empty()) Py_RETURN_NONE;
+  return PyLong_FromLongLong(t->heap->front().deadline);
+}
+
+// ---------------------------------------------------------------------------
+// TaskWaker — the per-task wake callable (reference: async-task's Waker).
+// Semantics identical to the Python closure in TaskEntry.__init__:
+//   if task.finished or task.scheduled: return
+//   task.scheduled = True; executor.ready.append(task)
+// Participates in GC (task <-> waker is a reference cycle).
+// ---------------------------------------------------------------------------
+
+struct TaskWakerObject {
+  PyObject_HEAD
+  PyObject* task;
+  PyObject* ready;  // the executor's ready list
+};
+
+static PyTypeObject TaskWakerType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "hostcore.TaskWaker",      /* tp_name */
+    sizeof(TaskWakerObject),   /* tp_basicsize */
+};
+
+static int taskwaker_fire(TaskWakerObject* w) {
+  int finished = attr_truth(w->task, s_finished);
+  if (finished < 0) return -1;
+  if (finished) return 0;
+  int scheduled = attr_truth(w->task, s_scheduled);
+  if (scheduled < 0) return -1;
+  if (scheduled) return 0;
+  if (PyObject_SetAttr(w->task, s_scheduled, Py_True) < 0) return -1;
+  return PyList_Append(w->ready, w->task);
+}
+
+static PyObject* TaskWaker_new(PyTypeObject* type, PyObject* args, PyObject*) {
+  PyObject *task, *ready;
+  if (!PyArg_ParseTuple(args, "OO!", &task, &PyList_Type, &ready)) {
+    return nullptr;
+  }
+  TaskWakerObject* self =
+      reinterpret_cast<TaskWakerObject*>(type->tp_alloc(type, 0));
+  if (!self) return nullptr;
+  Py_INCREF(task);
+  self->task = task;
+  Py_INCREF(ready);
+  self->ready = ready;
+  return reinterpret_cast<PyObject*>(self);
+}
+
+static void TaskWaker_dealloc(PyObject* self) {
+  TaskWakerObject* w = reinterpret_cast<TaskWakerObject*>(self);
+  PyObject_GC_UnTrack(self);
+  Py_XDECREF(w->task);
+  Py_XDECREF(w->ready);
+  Py_TYPE(self)->tp_free(self);
+}
+
+static int TaskWaker_traverse(PyObject* self, visitproc visit, void* arg) {
+  TaskWakerObject* w = reinterpret_cast<TaskWakerObject*>(self);
+  Py_VISIT(w->task);
+  Py_VISIT(w->ready);
+  return 0;
+}
+
+static int TaskWaker_clear(PyObject* self) {
+  TaskWakerObject* w = reinterpret_cast<TaskWakerObject*>(self);
+  Py_CLEAR(w->task);
+  Py_CLEAR(w->ready);
+  return 0;
+}
+
+static PyObject* TaskWaker_call(PyObject* self, PyObject*, PyObject*) {
+  if (taskwaker_fire(reinterpret_cast<TaskWakerObject*>(self)) < 0) {
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+// Pop the earliest timer, jump the clock, fire the callback
+// (reference: sim/time/mod.rs:45-59). 1 = fired, 0 = empty, -1 = error.
+static int advance_next(TimeCoreObject* t) {
+  if (t->heap->empty()) return 0;
+  std::pop_heap(t->heap->begin(), t->heap->end(), TimerCmp{});
+  TimerEnt e = t->heap->back();
+  t->heap->pop_back();
+  if (e.deadline > t->now_ns) t->now_ns = e.deadline;
+  int rc = 1;
+  if (Py_TYPE(e.cb) == &TaskWakerType) {
+    // fast path: wake a task without a Python call
+    if (taskwaker_fire(reinterpret_cast<TaskWakerObject*>(e.cb)) < 0) rc = -1;
+  } else {
+    PyObject* r = PyObject_CallNoArgs(e.cb);
+    if (!r) rc = -1;
+    Py_XDECREF(r);
+  }
+  Py_DECREF(e.cb);
+  return rc;
+}
+
+static PyObject* TimeCore_advance_to_next_event(PyObject* self, PyObject*) {
+  int rc = advance_next(reinterpret_cast<TimeCoreObject*>(self));
+  if (rc < 0) return nullptr;
+  return PyBool_FromLong(rc);
+}
+
+static Py_ssize_t TimeCore_len(PyObject* self) {
+  return static_cast<Py_ssize_t>(
+      reinterpret_cast<TimeCoreObject*>(self)->heap->size());
+}
+
+static PyMethodDef TimeCore_methods[] = {
+    {"now_ns", TimeCore_now_ns, METH_NOARGS, "current virtual time (ns)"},
+    {"advance_ns", TimeCore_advance_ns, METH_O, "jump the clock forward"},
+    {"push", TimeCore_push, METH_VARARGS, "push(deadline_ns, callback)"},
+    {"peek", TimeCore_peek, METH_NOARGS, "earliest deadline or None"},
+    {"advance_to_next_event", TimeCore_advance_to_next_event, METH_NOARGS,
+     "pop earliest timer, jump clock, fire callback; False when empty"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PySequenceMethods TimeCore_as_sequence = {
+    TimeCore_len, /* sq_length */
+};
+
+static PyTypeObject TimeCoreType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "hostcore.TimeCore",       /* tp_name */
+    sizeof(TimeCoreObject),    /* tp_basicsize */
+};
+
+// ---------------------------------------------------------------------------
+// AwaitIter — future._Await.__await__ as a native iterator.
+//
+// Python semantics being mirrored (future.py:56-77): loop { poll(waker);
+// Ready -> return value; PENDING -> task.pending_on = p; yield; clear },
+// with p.drop() on every exit path (return, GeneratorExit via close(),
+// error). Fetches the current task from the executor loop's thread-local
+// (or _context.current_task() under the pure-Python loop).
+// ---------------------------------------------------------------------------
+
+static thread_local PyObject* tl_current_task = nullptr;  // borrowed
+
+static PyObject* s_waker;
+static PyObject* s_pending_on;
+static PyObject* s_poll;
+static PyObject* s_value;
+static PyObject* s_drop;
+
+// Lazily-imported singletons from madsim_tpu (lazy: this module is built
+// and loaded by madsim_tpu._native during package import).
+static PyObject* g_pending = nullptr;       // future.PENDING
+static PyObject* g_ready_none = nullptr;    // shared Ready(None)
+static PyObject* g_current_task_fn = nullptr;  // _context.current_task
+
+static int ensure_future_imports() {
+  if (g_pending) return 0;
+  PyObject* fut = PyImport_ImportModule("madsim_tpu.future");
+  if (!fut) return -1;
+  g_pending = PyObject_GetAttrString(fut, "PENDING");
+  PyObject* ready_cls = PyObject_GetAttrString(fut, "Ready");
+  Py_DECREF(fut);
+  if (!g_pending || !ready_cls) {
+    Py_XDECREF(ready_cls);
+    return -1;
+  }
+  g_ready_none = PyObject_CallOneArg(ready_cls, Py_None);
+  Py_DECREF(ready_cls);
+  if (!g_ready_none) return -1;
+  PyObject* ctxmod = PyImport_ImportModule("madsim_tpu._context");
+  if (!ctxmod) return -1;
+  g_current_task_fn = PyObject_GetAttrString(ctxmod, "current_task");
+  Py_DECREF(ctxmod);
+  return g_current_task_fn ? 0 : -1;
+}
+
+struct AwaitIterObject {
+  PyObject_HEAD
+  PyObject* pollable;
+  PyObject* task;   // resolved on first __next__
+  PyObject* waker;  // cached task.waker
+  char yielded;     // pending_on is set; clear before the next poll
+  char done;
+};
+
+static void awaititer_run_drop(AwaitIterObject* it) {
+  // best-effort drop() preserving any in-flight exception
+  PyObject *t, *v, *tb;
+  PyErr_Fetch(&t, &v, &tb);
+  PyObject* r = PyObject_CallMethodNoArgs(it->pollable, s_drop);
+  if (!r) PyErr_WriteUnraisable(it->pollable);
+  Py_XDECREF(r);
+  PyErr_Restore(t, v, tb);
+}
+
+static PyObject* AwaitIter_next(PyObject* self) {
+  AwaitIterObject* it = reinterpret_cast<AwaitIterObject*>(self);
+  if (it->done) {
+    PyErr_SetNone(PyExc_StopIteration);
+    return nullptr;
+  }
+  if (!it->task) {
+    if (tl_current_task) {
+      it->task = tl_current_task;
+      Py_INCREF(it->task);
+    } else {
+      if (ensure_future_imports() < 0) return nullptr;
+      it->task = PyObject_CallNoArgs(g_current_task_fn);
+      if (!it->task) return nullptr;
+    }
+    it->waker = PyObject_GetAttr(it->task, s_waker);
+    if (!it->waker) return nullptr;
+  }
+  if (it->yielded) {
+    it->yielded = 0;
+    if (PyObject_SetAttr(it->task, s_pending_on, Py_None) < 0) return nullptr;
+  }
+  PyObject* r = PyObject_CallMethodOneArg(it->pollable, s_poll, it->waker);
+  if (!r) {
+    it->done = 1;
+    awaititer_run_drop(it);
+    return nullptr;
+  }
+  if (r == g_pending) {
+    Py_DECREF(r);
+    if (PyObject_SetAttr(it->task, s_pending_on, it->pollable) < 0) {
+      return nullptr;
+    }
+    it->yielded = 1;
+    Py_RETURN_NONE;  // yield (suspend the awaiting coroutine)
+  }
+  PyObject* value = PyObject_GetAttr(r, s_value);
+  Py_DECREF(r);
+  if (!value) {
+    it->done = 1;
+    awaititer_run_drop(it);
+    return nullptr;
+  }
+  it->done = 1;
+  awaititer_run_drop(it);
+  if (PyErr_Occurred()) {  // drop() must not mask, but self-errors count
+    Py_DECREF(value);
+    return nullptr;
+  }
+  // StopIteration(value): build the instance explicitly so tuple values
+  // survive normalization (same trick as _PyGen_SetStopIterationValue)
+  PyObject* exc = PyObject_CallOneArg(PyExc_StopIteration, value);
+  Py_DECREF(value);
+  if (!exc) return nullptr;
+  PyErr_SetObject(PyExc_StopIteration, exc);
+  Py_DECREF(exc);
+  return nullptr;
+}
+
+// Clear task.pending_on if we suspended with it set (the Python
+// version's `finally: task.pending_on = None`). Best-effort on teardown.
+static void awaititer_clear_pending(AwaitIterObject* it) {
+  if (it->yielded && it->task) {
+    it->yielded = 0;
+    if (PyObject_SetAttr(it->task, s_pending_on, Py_None) < 0) {
+      PyErr_WriteUnraisable(it->task);
+    }
+  }
+}
+
+// close(): called by the coroutine machinery when GeneratorExit unwinds
+// through the awaiting frame — the Python version's `finally` clauses.
+static PyObject* AwaitIter_close(PyObject* self, PyObject*) {
+  AwaitIterObject* it = reinterpret_cast<AwaitIterObject*>(self);
+  awaititer_clear_pending(it);
+  if (!it->done) {
+    it->done = 1;
+    PyObject* r = PyObject_CallMethodNoArgs(it->pollable, s_drop);
+    if (!r) return nullptr;
+    Py_DECREF(r);
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* AwaitIter_new(PyTypeObject* type, PyObject* args, PyObject*) {
+  PyObject* pollable;
+  if (!PyArg_ParseTuple(args, "O", &pollable)) return nullptr;
+  if (ensure_future_imports() < 0) return nullptr;
+  AwaitIterObject* self =
+      reinterpret_cast<AwaitIterObject*>(type->tp_alloc(type, 0));
+  if (!self) return nullptr;
+  Py_INCREF(pollable);
+  self->pollable = pollable;
+  self->task = nullptr;
+  self->waker = nullptr;
+  self->yielded = 0;
+  self->done = 0;
+  return reinterpret_cast<PyObject*>(self);
+}
+
+static void AwaitIter_dealloc(PyObject* self) {
+  AwaitIterObject* it = reinterpret_cast<AwaitIterObject*>(self);
+  PyObject_GC_UnTrack(self);
+  awaititer_clear_pending(it);
+  if (!it->done && it->pollable) {
+    it->done = 1;
+    awaititer_run_drop(it);
+  }
+  Py_XDECREF(it->pollable);
+  Py_XDECREF(it->task);
+  Py_XDECREF(it->waker);
+  Py_TYPE(self)->tp_free(self);
+}
+
+static int AwaitIter_traverse(PyObject* self, visitproc visit, void* arg) {
+  AwaitIterObject* it = reinterpret_cast<AwaitIterObject*>(self);
+  Py_VISIT(it->pollable);
+  Py_VISIT(it->task);
+  Py_VISIT(it->waker);
+  return 0;
+}
+
+static int AwaitIter_clear_gc(PyObject* self) {
+  AwaitIterObject* it = reinterpret_cast<AwaitIterObject*>(self);
+  Py_CLEAR(it->pollable);
+  Py_CLEAR(it->task);
+  Py_CLEAR(it->waker);
+  return 0;
+}
+
+static PyMethodDef AwaitIter_methods[] = {
+    {"close", AwaitIter_close, METH_NOARGS, "drop the pollable (GeneratorExit)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PyTypeObject AwaitIterType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "hostcore.AwaitIter",      /* tp_name */
+    sizeof(AwaitIterObject),   /* tp_basicsize */
+};
+
+// ---------------------------------------------------------------------------
+// SleepGate — the sleep pollable with a native poll
+// (semantics of time.SleepFuture: registers a timer-wake on each poll)
+// ---------------------------------------------------------------------------
+
+struct SleepGateObject {
+  PyObject_HEAD
+  long long deadline_ns;
+  char armed;  // a timer for this gate is already pending — don't re-push
+  TimeCoreObject* core;  // strong
+};
+
+static PyObject* SleepGate_new(PyTypeObject* type, PyObject* args, PyObject*) {
+  long long deadline;
+  PyObject* core;
+  if (!PyArg_ParseTuple(args, "LO!", &deadline, &TimeCoreType, &core)) {
+    return nullptr;
+  }
+  SleepGateObject* self =
+      reinterpret_cast<SleepGateObject*>(type->tp_alloc(type, 0));
+  if (!self) return nullptr;
+  self->deadline_ns = deadline;
+  self->armed = 0;
+  Py_INCREF(core);
+  self->core = reinterpret_cast<TimeCoreObject*>(core);
+  return reinterpret_cast<PyObject*>(self);
+}
+
+static void SleepGate_dealloc(PyObject* self) {
+  PyObject_GC_UnTrack(self);
+  Py_XDECREF(reinterpret_cast<SleepGateObject*>(self)->core);
+  Py_TYPE(self)->tp_free(self);
+}
+
+static int SleepGate_traverse(PyObject* self, visitproc visit, void* arg) {
+  Py_VISIT(reinterpret_cast<SleepGateObject*>(self)->core);
+  return 0;
+}
+
+static int SleepGate_clear_gc(PyObject* self) {
+  SleepGateObject* g = reinterpret_cast<SleepGateObject*>(self);
+  Py_CLEAR(g->core);
+  return 0;
+}
+
+static PyObject* SleepGate_poll(PyObject* self, PyObject* waker) {
+  SleepGateObject* g = reinterpret_cast<SleepGateObject*>(self);
+  if (ensure_future_imports() < 0) return nullptr;
+  if (g->core->now_ns >= g->deadline_ns) {
+    Py_INCREF(g_ready_none);
+    return g_ready_none;
+  }
+  if (!g->armed) {
+    // one timer per gate: re-polls before the deadline (e.g. from a race
+    // partner's wake) don't push duplicates — the armed timer fires at
+    // the deadline regardless (the pollable has a single awaiting task)
+    g->armed = 1;
+    Py_INCREF(waker);
+    g->core->heap->push_back(TimerEnt{g->deadline_ns, ++g->core->seq, waker});
+    std::push_heap(g->core->heap->begin(), g->core->heap->end(), TimerCmp{});
+  }
+  Py_INCREF(g_pending);
+  return g_pending;
+}
+
+static PyObject* SleepGate_drop(PyObject*, PyObject*) { Py_RETURN_NONE; }
+
+static PyObject* SleepGate_get_deadline(PyObject* self, void*) {
+  return PyLong_FromLongLong(
+      reinterpret_cast<SleepGateObject*>(self)->deadline_ns);
+}
+
+static PyMethodDef SleepGate_methods[] = {
+    {"poll", SleepGate_poll, METH_O, "poll(waker) -> Ready(None) | PENDING"},
+    {"drop", SleepGate_drop, METH_NOARGS, "no-op (stale wakes are harmless)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PyGetSetDef SleepGate_getset[] = {
+    {"deadline_ns", SleepGate_get_deadline, nullptr, "timer deadline", nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr},
+};
+
+static PyTypeObject SleepGateType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "hostcore.SleepGate",      /* tp_name */
+    sizeof(SleepGateObject),   /* tp_basicsize */
+};
+
+// ---------------------------------------------------------------------------
+// run_all_ready — the executor poll loop (sim/task/mod.rs:263-323)
+// ---------------------------------------------------------------------------
+
+// Mirrors Executor.run_all_ready + _poll_task exactly, including the RNG
+// draw pattern (pick draw only when len>1; advance draw after each
+// effective poll; no advance draw after a panic).
+// Returns 0 on success (queue drained or panic set), -1 on error.
+static int run_ready_impl(PyObject* executor, PyObject* ctx, RngObject* rng,
+                          TimeCoreObject* timec) {
+  PyObject* ready = PyObject_GetAttr(executor, s_ready);
+  if (!ready) return -1;
+  if (!PyList_Check(ready)) {
+    Py_DECREF(ready);
+    PyErr_SetString(PyExc_TypeError, "executor.ready must be a list");
+    return -1;
+  }
+
+  int ok = 0;  // 0 = error path, 1 = success
+  while (true) {
+    Py_ssize_t n = PyList_GET_SIZE(ready);
+    if (n == 0) {
+      ok = 1;
+      break;
+    }
+    // try_recv_random: swap-remove a uniformly random element
+    // (reference: sim/utils/mpsc.rs:73-83). Draw only when n > 1 —
+    // identical to the Python loop's draw pattern.
+    Py_ssize_t idx =
+        n > 1 ? static_cast<Py_ssize_t>(rng_range(rng, 0, n)) : 0;
+    PyObject* task = PyList_GET_ITEM(ready, idx);  // borrowed
+    Py_INCREF(task);
+    if (idx != n - 1) {
+      PyObject* last = PyList_GET_ITEM(ready, n - 1);  // borrowed
+      Py_INCREF(last);
+      // steals our `last` ref and decrefs the old slot value (task)
+      if (PyList_SetItem(ready, idx, last) < 0) {
+        Py_DECREF(task);
+        break;
+      }
+    }
+    if (PyList_SetSlice(ready, n - 1, n, nullptr) < 0) {
+      Py_DECREF(task);
+      break;
+    }
+
+    if (PyObject_SetAttr(task, s_scheduled, Py_False) < 0) {
+      Py_DECREF(task);
+      break;
+    }
+    int finished = attr_truth(task, s_finished);
+    if (finished < 0) { Py_DECREF(task); break; }
+    PyObject* node = PyObject_GetAttr(task, s_node);
+    if (!node) { Py_DECREF(task); break; }
+    int killed = attr_truth(node, s_killed);
+    if (killed < 0) { Py_DECREF(node); Py_DECREF(task); break; }
+    if (finished || killed) {
+      Py_DECREF(node);
+      Py_DECREF(task);
+      continue;
+    }
+    int paused = attr_truth(node, s_paused);
+    if (paused < 0) { Py_DECREF(node); Py_DECREF(task); break; }
+    if (paused) {
+      // park until resume (reference: sim/task/mod.rs:404-424)
+      PyObject* parked = PyObject_GetAttr(node, s_paused_tasks);
+      int fail = !parked || PyObject_SetAttr(task, s_scheduled, Py_True) < 0 ||
+                 PyList_Append(parked, task) < 0;
+      Py_XDECREF(parked);
+      Py_DECREF(node);
+      Py_DECREF(task);
+      if (fail) break;
+      continue;
+    }
+
+    // ---- _poll_task ----
+    PyObject* prev_task = PyObject_GetAttr(ctx, s_current_task);
+    if (!prev_task) { Py_DECREF(node); Py_DECREF(task); break; }
+    if (PyObject_SetAttr(ctx, s_current_task, task) < 0 ||
+        PyObject_SetAttr(executor, s_running_task, task) < 0) {
+      Py_DECREF(prev_task); Py_DECREF(node); Py_DECREF(task);
+      break;
+    }
+    PyObject* coro = PyObject_GetAttr(task, s_coro);
+    int poll_failed = 0;
+    if (!coro) {
+      poll_failed = 1;
+    } else {
+      PyObject* result = nullptr;
+      PyObject* tl_prev = tl_current_task;
+      tl_current_task = task;  // borrowed; AwaitIter reads it during send
+      PySendResult sr = PyIter_Send(coro, Py_None, &result);
+      tl_current_task = tl_prev;
+      Py_DECREF(coro);
+      if (sr == PYGEN_RETURN) {
+        // StopIteration: task completed with `result`
+        poll_failed = 1;  // cleared on full success
+        if (PyObject_SetAttr(task, s_finished, Py_True) == 0) {
+          PyObject* tasks = PyObject_GetAttr(node, s_tasks);
+          if (tasks) {
+            PyObject* r1 = PyObject_CallMethodOneArg(tasks, s_discard, task);
+            if (r1) {
+              Py_DECREF(r1);
+              PyObject* cell = PyObject_GetAttr(task, s_cell);
+              if (cell) {
+                PyObject* pair = PyTuple_Pack(2, result, Py_None);
+                if (pair) {
+                  PyObject* r2 = PyObject_CallMethodOneArg(cell, s_set, pair);
+                  if (r2) {
+                    Py_DECREF(r2);
+                    poll_failed = 0;
+                  }
+                  Py_DECREF(pair);
+                }
+                Py_DECREF(cell);
+              }
+            }
+            Py_DECREF(tasks);
+          }
+        }
+        Py_DECREF(result);
+      } else if (sr == PYGEN_NEXT) {
+        Py_XDECREF(result);  // yielded value (always None) — task suspended
+      } else {
+        // PYGEN_ERROR: the "panic" path — only `Exception` subclasses are
+        // handled (reference catch_unwind); BaseExceptions propagate.
+        if (PyErr_ExceptionMatches(PyExc_Exception)) {
+          PyObject *etype, *evalue, *etb;
+          PyErr_Fetch(&etype, &evalue, &etb);
+          PyErr_NormalizeException(&etype, &evalue, &etb);
+          if (etb) PyException_SetTraceback(evalue, etb);
+          poll_failed = 1;  // cleared on full success
+          if (PyObject_SetAttr(task, s_finished, Py_True) == 0) {
+            PyObject* tasks = PyObject_GetAttr(node, s_tasks);
+            if (tasks) {
+              PyObject* r1 = PyObject_CallMethodOneArg(tasks, s_discard, task);
+              if (r1) {
+                Py_DECREF(r1);
+                PyObject* r2 = PyObject_CallMethodObjArgs(
+                    executor, s_handle_panic, task, evalue, nullptr);
+                if (r2) {
+                  Py_DECREF(r2);
+                  poll_failed = 0;
+                }
+              }
+              Py_DECREF(tasks);
+            }
+          }
+          Py_XDECREF(etype);
+          Py_XDECREF(evalue);
+          Py_XDECREF(etb);
+        } else {
+          poll_failed = 1;  // propagate (GeneratorExit, KeyboardInterrupt..)
+        }
+      }
+    }
+    // finally: restore context even when an exception is propagating —
+    // stash/restore the pending exception around the cleanup setattrs
+    // (calling the attribute API with an exception set is not allowed)
+    {
+      PyObject *p_type = nullptr, *p_val = nullptr, *p_tb = nullptr;
+      if (PyErr_Occurred()) PyErr_Fetch(&p_type, &p_val, &p_tb);
+      if (PyObject_SetAttr(executor, s_running_task, Py_None) < 0 ||
+          PyObject_SetAttr(ctx, s_current_task, prev_task) < 0) {
+        poll_failed = 1;
+        if (p_type) PyErr_Clear();  // original exception wins
+      }
+      if (p_type) PyErr_Restore(p_type, p_val, p_tb);
+    }
+    Py_DECREF(prev_task);
+    Py_DECREF(node);
+
+    if (!poll_failed) {
+      // deferred self-cancellation (task.cancel() from inside the task)
+      int kill_req = attr_truth(task, s_kill_requested);
+      int fin2 = kill_req < 0 ? -1 : attr_truth(task, s_finished);
+      if (kill_req < 0 || fin2 < 0) {
+        poll_failed = 1;
+      } else if (kill_req && !fin2) {
+        if (PyObject_SetAttr(task, s_kill_requested, Py_False) < 0) {
+          poll_failed = 1;
+        } else {
+          PyObject* r = PyObject_CallMethodNoArgs(task, s_close_priv);
+          if (!r) poll_failed = 1;
+          Py_XDECREF(r);
+        }
+      }
+    }
+    Py_DECREF(task);
+    if (poll_failed) break;
+
+    // stop draining on panic — BEFORE the advance draw (Python parity)
+    PyObject* panic = PyObject_GetAttr(executor, s_panic);
+    if (!panic) break;
+    int has_panic = panic != Py_None;
+    Py_DECREF(panic);
+    if (has_panic) {
+      ok = 1;
+      break;
+    }
+    // Virtual time advances 50-100 ns per poll (reference :319-321).
+    timec->now_ns += rng_range(rng, 50, 101);
+  }
+
+  Py_DECREF(ready);
+  return ok ? 0 : -1;
+}
+
+static PyObject* host_run_all_ready(PyObject*, PyObject* args) {
+  PyObject *executor, *ctx, *rng_o, *time_o;
+  if (!PyArg_ParseTuple(args, "OOO!O!", &executor, &ctx, &RngType, &rng_o,
+                        &TimeCoreType, &time_o)) {
+    return nullptr;
+  }
+  if (run_ready_impl(executor, ctx, reinterpret_cast<RngObject*>(rng_o),
+                     reinterpret_cast<TimeCoreObject*>(time_o)) < 0) {
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+// drive(executor, ctx, rng, time_core, main_task) -> int
+//
+// The full Executor.block_on inner loop (reference: sim/task/mod.rs:220-260)
+// natively: drain ready queue, then jump to the next timer; repeat.
+// Return codes (the Python side raises accordingly):
+//   0 = main task finished    1 = panic set
+//   2 = time limit hit        3 = deadlock (no timers pending)
+static PyObject* host_drive(PyObject*, PyObject* args) {
+  PyObject *executor, *ctx, *rng_o, *time_o, *main_task;
+  if (!PyArg_ParseTuple(args, "OOO!O!O", &executor, &ctx, &RngType, &rng_o,
+                        &TimeCoreType, &time_o, &main_task)) {
+    return nullptr;
+  }
+  RngObject* rng = reinterpret_cast<RngObject*>(rng_o);
+  TimeCoreObject* timec = reinterpret_cast<TimeCoreObject*>(time_o);
+  while (true) {
+    if (run_ready_impl(executor, ctx, rng, timec) < 0) return nullptr;
+    PyObject* panic = PyObject_GetAttr(executor, s_panic);
+    if (!panic) return nullptr;
+    int has_panic = panic != Py_None;
+    Py_DECREF(panic);
+    if (has_panic) return PyLong_FromLong(1);
+    int fin = attr_truth(main_task, s_finished);
+    if (fin < 0) return nullptr;
+    if (fin) return PyLong_FromLong(0);
+    int limit = attr_truth(executor, s_time_limit_hit);
+    if (limit < 0) return nullptr;
+    if (limit) return PyLong_FromLong(2);
+    int rc = advance_next(timec);
+    if (rc < 0) return nullptr;
+    if (rc == 0) return PyLong_FromLong(3);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// philox_fill — bulk block generation (kept for GlobalRng fallback + tests)
+// ---------------------------------------------------------------------------
+
+static PyObject* host_philox_fill(PyObject*, PyObject* args) {
+  unsigned long k0, k1;
+  unsigned long long start_block, nblocks;
+  if (!PyArg_ParseTuple(args, "kkKK", &k0, &k1, &start_block, &nblocks)) {
+    return nullptr;
+  }
+  PyObject* out = PyList_New(static_cast<Py_ssize_t>(4 * nblocks));
+  if (!out) return nullptr;
+  uint32_t words[4];
+  for (unsigned long long i = 0; i < nblocks; ++i) {
+    unsigned long long block = start_block + i;
+    philox_block(static_cast<uint32_t>(k0), static_cast<uint32_t>(k1),
+                 static_cast<uint32_t>(block),
+                 static_cast<uint32_t>(block >> 32), 0u, 0u, words);
+    for (int w = 0; w < 4; ++w) {
+      PyObject* v = PyLong_FromUnsignedLong(words[w]);
+      if (!v) { Py_DECREF(out); return nullptr; }
+      PyList_SET_ITEM(out, static_cast<Py_ssize_t>(4 * i + w), v);
+    }
+  }
+  return out;
+}
+
+static PyMethodDef module_methods[] = {
+    {"run_all_ready", host_run_all_ready, METH_VARARGS,
+     "run_all_ready(executor, ctx, rng, time_core) — native poll loop"},
+    {"drive", host_drive, METH_VARARGS,
+     "drive(executor, ctx, rng, time_core, main_task) -> outcome code"},
+    {"philox_fill", host_philox_fill, METH_VARARGS,
+     "philox_fill(k0, k1, start_block, nblocks) -> list of 4*n uint32"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef hostcore_module = {
+    PyModuleDef_HEAD_INIT, "hostcore",
+    "native hot paths for the madsim_tpu host engine", -1, module_methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_hostcore(void) {
+  RngType.tp_flags = Py_TPFLAGS_DEFAULT;
+  RngType.tp_new = Rng_new;
+  RngType.tp_methods = Rng_methods;
+  RngType.tp_doc = "buffered Philox4x32-10 draw stream";
+  if (PyType_Ready(&RngType) < 0) return nullptr;
+
+  TimeCoreType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  TimeCoreType.tp_new = TimeCore_new;
+  TimeCoreType.tp_dealloc = TimeCore_dealloc;
+  TimeCoreType.tp_traverse = TimeCore_traverse;
+  TimeCoreType.tp_clear = TimeCore_clear_gc;
+  TimeCoreType.tp_methods = TimeCore_methods;
+  TimeCoreType.tp_as_sequence = &TimeCore_as_sequence;
+  TimeCoreType.tp_doc = "virtual clock + (deadline, seq) timer heap";
+  if (PyType_Ready(&TimeCoreType) < 0) return nullptr;
+
+  TaskWakerType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  TaskWakerType.tp_new = TaskWaker_new;
+  TaskWakerType.tp_dealloc = TaskWaker_dealloc;
+  TaskWakerType.tp_traverse = TaskWaker_traverse;
+  TaskWakerType.tp_clear = TaskWaker_clear;
+  TaskWakerType.tp_call = TaskWaker_call;
+  TaskWakerType.tp_doc = "per-task wake callable (schedule into ready)";
+  if (PyType_Ready(&TaskWakerType) < 0) return nullptr;
+
+  AwaitIterType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  AwaitIterType.tp_new = AwaitIter_new;
+  AwaitIterType.tp_dealloc = AwaitIter_dealloc;
+  AwaitIterType.tp_traverse = AwaitIter_traverse;
+  AwaitIterType.tp_clear = AwaitIter_clear_gc;
+  AwaitIterType.tp_iter = PyObject_SelfIter;
+  AwaitIterType.tp_iternext = AwaitIter_next;
+  AwaitIterType.tp_methods = AwaitIter_methods;
+  AwaitIterType.tp_doc = "native __await__ iterator over a Pollable";
+  if (PyType_Ready(&AwaitIterType) < 0) return nullptr;
+
+  SleepGateType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  SleepGateType.tp_new = SleepGate_new;
+  SleepGateType.tp_dealloc = SleepGate_dealloc;
+  SleepGateType.tp_traverse = SleepGate_traverse;
+  SleepGateType.tp_clear = SleepGate_clear_gc;
+  SleepGateType.tp_methods = SleepGate_methods;
+  SleepGateType.tp_getset = SleepGate_getset;
+  SleepGateType.tp_doc = "sleep pollable with a native poll";
+  if (PyType_Ready(&SleepGateType) < 0) return nullptr;
+
+#define INTERN(var, name)                     \
+  var = PyUnicode_InternFromString(name);     \
+  if (!var) return nullptr;
+  INTERN(s_time_limit_hit, "_time_limit_hit")
+  INTERN(s_waker, "waker")
+  INTERN(s_pending_on, "pending_on")
+  INTERN(s_poll, "poll")
+  INTERN(s_value, "value")
+  INTERN(s_drop, "drop")
+  INTERN(s_ready, "ready")
+  INTERN(s_scheduled, "scheduled")
+  INTERN(s_finished, "finished")
+  INTERN(s_kill_requested, "kill_requested")
+  INTERN(s_node, "node")
+  INTERN(s_coro, "coro")
+  INTERN(s_cell, "cell")
+  INTERN(s_killed, "killed")
+  INTERN(s_paused, "paused")
+  INTERN(s_paused_tasks, "paused_tasks")
+  INTERN(s_tasks, "tasks")
+  INTERN(s_discard, "discard")
+  INTERN(s_set, "set")
+  INTERN(s_close_priv, "_close")
+  INTERN(s_current_task, "current_task")
+  INTERN(s_running_task, "running_task")
+  INTERN(s_panic, "panic")
+  INTERN(s_handle_panic, "_handle_panic")
+#undef INTERN
+
+  PyObject* m = PyModule_Create(&hostcore_module);
+  if (!m) return nullptr;
+  Py_INCREF(&RngType);
+  if (PyModule_AddObject(m, "Rng", reinterpret_cast<PyObject*>(&RngType)) < 0) {
+    Py_DECREF(&RngType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  Py_INCREF(&TimeCoreType);
+  if (PyModule_AddObject(m, "TimeCore",
+                         reinterpret_cast<PyObject*>(&TimeCoreType)) < 0) {
+    Py_DECREF(&TimeCoreType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  Py_INCREF(&TaskWakerType);
+  if (PyModule_AddObject(m, "TaskWaker",
+                         reinterpret_cast<PyObject*>(&TaskWakerType)) < 0) {
+    Py_DECREF(&TaskWakerType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  Py_INCREF(&AwaitIterType);
+  if (PyModule_AddObject(m, "AwaitIter",
+                         reinterpret_cast<PyObject*>(&AwaitIterType)) < 0) {
+    Py_DECREF(&AwaitIterType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  Py_INCREF(&SleepGateType);
+  if (PyModule_AddObject(m, "SleepGate",
+                         reinterpret_cast<PyObject*>(&SleepGateType)) < 0) {
+    Py_DECREF(&SleepGateType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  return m;
+}

@@ -25,6 +25,22 @@ from .errors import RecvError
 
 __all__ = ["PENDING", "Pollable", "Ready", "await_", "OneShotCell", "yield_now"]
 
+# Native __await__ iterator — resolved lazily on first await so that a
+# bare `import madsim_tpu` never triggers the g++ build of hostcore.
+_AwaitIter = None
+_await_iter_resolved = False
+
+
+def _resolve_await_iter():
+    global _AwaitIter, _await_iter_resolved
+    _await_iter_resolved = True
+    from . import _native
+
+    mod = _native.get_mod()
+    if mod is not None:
+        _AwaitIter = mod.AwaitIter
+    return _AwaitIter
+
 
 class _Pending:
     __slots__ = ()
@@ -60,6 +76,14 @@ class _Await:
         self.pollable = pollable
 
     def __await__(self) -> Generator[None, None, Any]:
+        it = _AwaitIter
+        if it is None and not _await_iter_resolved:
+            it = _resolve_await_iter()
+        if it is not None:
+            return it(self.pollable)  # native iterator, same protocol
+        return self._await_py()
+
+    def _await_py(self) -> Generator[None, None, Any]:
         p = self.pollable
         try:
             while True:

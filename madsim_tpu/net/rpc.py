@@ -41,7 +41,12 @@ class Request:
 
     @classmethod
     def type_id(cls) -> int:
-        return hash_str(f"{cls.__module__}.{cls.__qualname__}")
+        # per-class cache (__dict__ check: subclasses must not inherit it)
+        tid = cls.__dict__.get("_type_id_cache")
+        if tid is None:
+            tid = hash_str(f"{cls.__module__}.{cls.__qualname__}")
+            cls._type_id_cache = tid
+        return tid
 
 
 Handler = Callable[..., Awaitable[Any]]

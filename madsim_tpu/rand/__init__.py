@@ -56,35 +56,45 @@ class GlobalRng:
         self.buggify_enabled = False
         from .. import _native
 
-        self._native_fill = _native.philox_fill if _native.available() else None
+        # Native draw stream (hostcore.Rng) — the SAME stream object the
+        # native executor loop draws from, so scheduling draws and user
+        # draws interleave identically to the pure-Python loop.
+        self._core = (
+            _native.make_rng(self._key[0], self._key[1])
+            if _native.available()
+            else None
+        )
 
     # -- core draws ---------------------------------------------------------
 
-    _NATIVE_REFILL_BLOCKS = 64  # 256 words per native call
+    @property
+    def recording(self) -> bool:
+        """True while the determinism log/check observes every draw; the
+        executor routes through its Python loop then (the native loop's
+        internal draws would bypass `_record`)."""
+        return self._log is not None or self._check is not None
 
     def _refill(self) -> None:
-        """Refill the word buffer; bulk-generates via the C++ core when
-        available (resolved once in __init__). The word *sequence* is
-        identical either way (blocks are consumed in counter order), so
-        native/pure runs are bit-identical."""
+        """Refill the pure-Python word buffer (native builds draw from
+        `_core` instead; the word *sequence* is identical either way)."""
         c = self._counter
-        if self._native_fill is not None:
-            n = self._NATIVE_REFILL_BLOCKS
-            self._buf = self._native_fill(self._key[0], self._key[1], c, n)
-            self._counter += n
-        else:
-            self._buf = list(
-                philox4x32(self._key, (c & 0xFFFFFFFF, (c >> 32) & 0xFFFFFFFF, 0, 0))
-            )
-            self._counter += 1
+        self._buf = list(
+            philox4x32(self._key, (c & 0xFFFFFFFF, (c >> 32) & 0xFFFFFFFF, 0, 0))
+        )
+        self._counter += 1
         self._buf_pos = 0
 
     def next_u32(self) -> int:
-        if self._buf_pos >= len(self._buf):
-            self._refill()
-        v = self._buf[self._buf_pos]
-        self._buf_pos += 1
-        self._record(v)
+        core = self._core
+        if core is not None:
+            v = core.next_u32()
+        else:
+            if self._buf_pos >= len(self._buf):
+                self._refill()
+            v = self._buf[self._buf_pos]
+            self._buf_pos += 1
+        if self._log is not None or self._check is not None:
+            self._record(v)
         return v
 
     def next_u64(self) -> int:

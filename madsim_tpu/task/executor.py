@@ -34,6 +34,10 @@ logger = logging.getLogger("madsim_tpu")
 MAIN_NODE_ID = 1
 
 
+async def _drive_awaitable(aw):
+    return await aw
+
+
 class NodeInfo:
     """A simulated process (reference: sim/task/mod.rs:87 `NodeInfo`)."""
 
@@ -88,13 +92,18 @@ class TaskEntry:
         self.location = location
         self.executor = executor
 
-        def waker(task: "TaskEntry" = self) -> None:
-            if task.finished or task.scheduled:
-                return
-            task.scheduled = True
-            task.executor.ready.append(task)
+        mod = executor._native_mod
+        if mod is not None:
+            # native wake callable — also fired C-internally by timers
+            self.waker = mod.TaskWaker(self, executor.ready)
+        else:
+            def waker(task: "TaskEntry" = self) -> None:
+                if task.finished or task.scheduled:
+                    return
+                task.scheduled = True
+                task.executor.ready.append(task)
 
-        self.waker = waker
+            self.waker = waker
 
     def cancel(self) -> None:
         """Drop the future (reference: kill path sim/task/mod.rs:133-140)."""
@@ -141,6 +150,13 @@ class Executor:
         self.create_hooks: List[Callable[[int], None]] = []
         # task census for metrics (reference: sim/runtime/metrics.rs)
         self.spawn_counts: Dict[int, Dict[str, int]] = {}
+        # Native poll loop (hostcore.run_all_ready): used when the RNG +
+        # clock cores are native and the determinism log/check is off
+        # (the log must observe every draw). Draw-for-draw identical to
+        # the Python loop, so either path replays the other's seeds.
+        from .. import _native
+
+        self._native_mod = _native.get_mod()
         self.main_node = self.create_node("main")
 
     # -- nodes --------------------------------------------------------------
@@ -211,6 +227,11 @@ class Executor:
     # -- spawning -----------------------------------------------------------
 
     def spawn(self, coro: Coroutine, node: NodeInfo, location: str, name: str = "") -> TaskEntry:
+        if not hasattr(coro, "send"):
+            # plain awaitables (e.g. the sleep future) are driven via a
+            # coroutine shim — spawn accepts anything awaitable, like
+            # tokio::spawn takes any Future
+            coro = _drive_awaitable(coro)
         if node.killed:
             coro.close()
             task = TaskEntry(0, coro, node, self, location, name)
@@ -231,31 +252,62 @@ class Executor:
     def block_on(self, main_coro: Coroutine) -> Any:
         """Reference: sim/task/mod.rs:220-260 `Executor::block_on`."""
         main_task = self.spawn(main_coro, self.main_node, location="<main>")
+        mod = self._native_mod
+        rng = self.rng
         while True:
-            self.run_all_ready()
-            if self.panic is not None:
+            if (
+                mod is not None
+                and rng._core is not None
+                and self.time._core is not None
+                and not rng.recording
+            ):
+                # the whole inner loop (drain + timer jump) runs in C
+                code = mod.drive(
+                    self, _context.current(), rng._core, self.time._core, main_task
+                )
+            else:
+                self.run_all_ready()
+                if self.panic is not None:
+                    code = 1
+                elif main_task.finished:
+                    code = 0
+                elif self._time_limit_hit:
+                    code = 2
+                elif not self.time.advance_to_next_event():
+                    code = 3
+                else:
+                    continue
+            if code == 1:
                 panic, self.panic = self.panic, None
                 raise panic
-            if main_task.finished:
+            if code == 0:
                 value, exc = main_task.cell.peek()
                 if exc is not None:
                     raise exc
                 return value
-            if self._time_limit_hit:
+            if code == 2:
                 raise TimeLimitExceeded(
                     f"time limit ({self.time_limit_ns / SEC}s) exceeded at "
                     f"t={self.time.elapsed()}s"
                 )
-            if not self.time.advance_to_next_event():
-                raise Deadlock(
-                    "all tasks are blocked and no timer is pending — "
-                    "the simulation would block forever (deadlock)"
-                )
+            raise Deadlock(
+                "all tasks are blocked and no timer is pending — "
+                "the simulation would block forever (deadlock)"
+            )
 
     def run_all_ready(self) -> None:
         """Drain the ready queue in random order (reference :263-323)."""
-        ready = self.ready
+        mod = self._native_mod
         rng = self.rng
+        if (
+            mod is not None
+            and rng._core is not None
+            and self.time._core is not None
+            and not rng.recording
+        ):
+            mod.run_all_ready(self, _context.current(), rng._core, self.time._core)
+            return
+        ready = self.ready
         while ready:
             # try_recv_random: swap-remove a uniformly random element
             # (reference: sim/utils/mpsc.rs:73-83).

@@ -53,6 +53,23 @@ SEC = 1_000_000_000
 _JAN_2022_NS = 1_640_995_200 * SEC
 
 
+# Native sleep pollable — resolved lazily on first sleep so that a bare
+# `import madsim_tpu` never triggers the g++ build of hostcore.
+_SleepGate = None
+_sleep_gate_resolved = False
+
+
+def _resolve_sleep_gate():
+    global _SleepGate, _sleep_gate_resolved
+    _sleep_gate_resolved = True
+    from .. import _native
+
+    mod = _native.get_mod()
+    if mod is not None:
+        _SleepGate = mod.SleepGate
+    return _SleepGate
+
+
 def to_ns(duration: Union[int, float]) -> int:
     """Convert seconds (int/float) to integer nanoseconds.
 
@@ -73,12 +90,12 @@ class TimeHandle:
         self._now_ns = 0
         self._heap: List[Tuple[int, int, Callable[[], None]]] = []
         self._seq = 0  # FIFO tie-break for equal deadlines (deterministic)
-        # Native timer heap (C++ core) when available; same
-        # (deadline, seq) ordering as the heapq fallback.
+        # Native clock + timer heap (hostcore.TimeCore) when available —
+        # the same (deadline, seq) ordering as the heapq fallback, with
+        # callbacks held natively (no id->callback dict round trip).
         from .. import _native
 
-        self._native_heap = _native.NativeTimerHeap() if _native.available() else None
-        self._callbacks: dict = {}
+        self._core = _native.make_time_core() if _native.available() else None
         # Random base wall clock ~year 2022 + up to one year of offset
         # (reference: sim/time/mod.rs:26-31).
         self.base_system_ns = _JAN_2022_NS + rng.gen_range(0, 365 * 24 * 3600) * SEC
@@ -86,31 +103,37 @@ class TimeHandle:
     # -- clock --------------------------------------------------------------
 
     def now_ns(self) -> int:
-        return self._now_ns
+        core = self._core
+        return core.now_ns() if core is not None else self._now_ns
 
     def elapsed(self) -> float:
-        return self._now_ns / SEC
+        return self.now_ns() / SEC
 
     def system_now_ns(self) -> int:
-        return self.base_system_ns + self._now_ns
+        return self.base_system_ns + self.now_ns()
 
     def advance_ns(self, delta_ns: int) -> None:
         """Manually jump the clock forward (reference: mod.rs:185-190)."""
-        self._now_ns += delta_ns
+        core = self._core
+        if core is not None:
+            core.advance_ns(delta_ns)
+        else:
+            self._now_ns += delta_ns
 
     # -- timers -------------------------------------------------------------
 
     def add_timer_ns(self, deadline_ns: int, callback: Callable[[], None]) -> None:
-        self._seq += 1
-        if self._native_heap is not None:
-            self._callbacks[self._seq] = callback
-            self._native_heap.push(deadline_ns, self._seq)
+        core = self._core
+        if core is not None:
+            core.push(deadline_ns, callback)
         else:
+            self._seq += 1
             heapq.heappush(self._heap, (deadline_ns, self._seq, callback))
 
     def next_event_ns(self) -> Optional[int]:
-        if self._native_heap is not None:
-            return self._native_heap.peek_deadline()
+        core = self._core
+        if core is not None:
+            return core.peek()
         return self._heap[0][0] if self._heap else None
 
     def advance_to_next_event(self) -> bool:
@@ -119,16 +142,12 @@ class TimeHandle:
         Returns False when no timer is pending (deadlock, unless the main
         future completed). Reference: sim/time/mod.rs:45-59.
         """
-        if self._native_heap is not None:
-            popped = self._native_heap.pop()
-            if popped is None:
-                return False
-            deadline, seq = popped
-            callback = self._callbacks.pop(seq)
-        else:
-            if not self._heap:
-                return False
-            deadline, _seq, callback = heapq.heappop(self._heap)
+        core = self._core
+        if core is not None:
+            return core.advance_to_next_event()
+        if not self._heap:
+            return False
+        deadline, _seq, callback = heapq.heappop(self._heap)
         if deadline > self._now_ns:
             self._now_ns = deadline
         callback()
@@ -227,36 +246,57 @@ UNIX_EPOCH = SystemTime(0)
 
 
 class SleepFuture(Pollable):
-    """Registers a timer-wake on each poll (reference: sleep.rs:47-55)."""
+    """Registers a timer-wake on first poll (reference: sleep.rs:47-55).
 
-    __slots__ = ("deadline_ns",)
+    One timer per future: re-polls before the deadline (e.g. a race
+    partner's wake) don't push duplicate timers — the armed timer fires
+    at the deadline regardless (a pollable has a single awaiting task)."""
+
+    __slots__ = ("deadline_ns", "_armed")
 
     def __init__(self, deadline_ns: int):
         self.deadline_ns = deadline_ns
+        self._armed = False
 
     def poll(self, waker: Callable[[], None]):
         th = _context.current_time()
         if th.now_ns() >= self.deadline_ns:
             return Ready(None)
-        th.add_timer_ns(self.deadline_ns, waker)
+        if not self._armed:
+            self._armed = True
+            th.add_timer_ns(self.deadline_ns, waker)
         return PENDING
+
+
+def _sleep_pollable(th: "TimeHandle", deadline_ns: int):
+    """The sleep pollable: native gate (poll fully in C) when the clock
+    core is native, else the Python SleepFuture — same semantics."""
+    core = th._core
+    if core is not None:
+        gate = _SleepGate
+        if gate is None and not _sleep_gate_resolved:
+            gate = _resolve_sleep_gate()
+        if gate is not None:
+            return gate(deadline_ns, core)
+    return SleepFuture(deadline_ns)
 
 
 async def sleep(duration: Union[int, float]) -> None:
     """Sleep for `duration` seconds of virtual time."""
     th = _context.current_time()
-    await await_(SleepFuture(th.now_ns() + to_ns(duration)))
+    await await_(_sleep_pollable(th, th.now_ns() + to_ns(duration)))
 
 
 async def sleep_ns(duration_ns: int) -> None:
     """Sleep for an integer-nanosecond duration (the framework-internal
     form; chaos latencies are always drawn in ns)."""
     th = _context.current_time()
-    await await_(SleepFuture(th.now_ns() + duration_ns))
+    await await_(_sleep_pollable(th, th.now_ns() + duration_ns))
 
 
 async def sleep_until(deadline: Instant) -> None:
-    await await_(SleepFuture(deadline._ns))
+    th = _context.current_time()
+    await await_(_sleep_pollable(th, deadline._ns))
 
 
 class _Race(Pollable):
@@ -287,7 +327,7 @@ async def timeout(duration: Union[int, float], fut: Union[Pollable, Awaitable]) 
     from ..task import spawn  # local import: task depends on time
 
     th = _context.current_time()
-    deadline = SleepFuture(th.now_ns() + to_ns(duration))
+    deadline = _sleep_pollable(th, th.now_ns() + to_ns(duration))
     if isinstance(fut, Pollable):
         idx, value = await await_(_Race([fut, deadline]))
         if idx == 0:
@@ -328,7 +368,7 @@ class Interval:
 
     async def tick(self) -> Instant:
         th = _context.current_time()
-        await await_(SleepFuture(self._deadline_ns))
+        await await_(_sleep_pollable(th, self._deadline_ns))
         now = th.now_ns()
         fired = self._deadline_ns
         b = self.missed_tick_behavior

@@ -30,17 +30,38 @@ def test_native_philox_matches_python():
     assert words2 == expected[20:24]
 
 
-def test_native_timer_heap_ordering():
-    heap = _native.NativeTimerHeap()
-    heap.push(100, 2)
-    heap.push(50, 1)
-    heap.push(100, 3)  # same deadline: FIFO by seq
-    heap.push(50, 4)
-    assert heap.peek_deadline() == 50
-    popped = [heap.pop() for _ in range(4)]
-    assert popped == [(50, 1), (50, 4), (100, 2), (100, 3)]
-    assert heap.pop() is None
-    assert len(heap) == 0
+def test_native_time_core_ordering():
+    core = _native.make_time_core()
+    fired = []
+    core.push(100, lambda: fired.append("b"))
+    core.push(50, lambda: fired.append("a"))
+    core.push(100, lambda: fired.append("c"))  # same deadline: FIFO by seq
+    core.push(50, lambda: fired.append("a2"))
+    assert core.peek() == 50
+    assert len(core) == 4
+    while core.advance_to_next_event():
+        pass
+    assert fired == ["a", "a2", "b", "c"]
+    assert core.now_ns() == 100
+    core.advance_ns(17)
+    assert core.now_ns() == 117
+    assert core.peek() is None
+
+
+def test_native_rng_matches_global_rng_derived_draws():
+    # gen_range/random on the native core use the same bit recipe as the
+    # Python GlobalRng methods (low + u64 % span; 53-bit float)
+    rng_py = GlobalRng(1234)
+    rng_py._core = None  # force the pure-Python buffer path
+    core = _native.make_rng(*GlobalRng(1234)._key)
+    for _ in range(200):
+        assert core.gen_range(50, 101) == rng_py.gen_range(50, 101)
+    rng_py2 = GlobalRng(77)
+    rng_py2._core = None
+    core2 = _native.make_rng(*GlobalRng(77)._key)
+    for _ in range(50):
+        assert core2.random() == rng_py2.random()
+        assert core2.next_u64() == rng_py2.next_u64()
 
 
 def test_global_rng_same_with_and_without_native():
