@@ -8,8 +8,11 @@ benchmark workload (BASELINE.json configs).
 invariant (the etcd-class kill/restart workload).
 `mq` — idempotent-producer message queue, per-producer gapless ordering
 invariant (the rdkafka-class workload).
+`etcd` — leased-KV leader election (grant/campaign/keepalive over an
+MVCC server), lease-safety invariant (the madsim-etcd-client service-
+class workload, batched).
 """
 
-from . import echo, kv, mq, raft
+from . import echo, etcd, kv, mq, raft
 
-__all__ = ["echo", "kv", "mq", "raft"]
+__all__ = ["echo", "etcd", "kv", "mq", "raft"]
