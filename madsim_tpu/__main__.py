@@ -23,6 +23,7 @@ import sys
 def _build_engine(args):
     from .engine import Engine, EngineConfig, FaultPlan
     from .models.echo import EchoMachine
+    from .models.etcd import EtcdMachine
     from .models.kv import KvMachine
     from .models.mq import MqMachine
     from .models.raft import RaftMachine
@@ -32,16 +33,21 @@ def _build_engine(args):
         "raft": lambda: RaftMachine(num_nodes=args.nodes or 5, log_capacity=8),
         "kv": lambda: KvMachine(num_nodes=args.nodes or 4),
         "mq": lambda: MqMachine(num_nodes=args.nodes or 4),
+        "etcd": lambda: EtcdMachine(num_nodes=args.nodes or 4),
     }
     if args.machine not in machines:
         sys.exit(f"unknown machine {args.machine!r}; choose from {sorted(machines)}")
     cfg = EngineConfig(
-        horizon_us=int(args.horizon * 1e6),
+        # round, not truncate: a shrunk repro prints horizon_us/1e6 and
+        # float truncation would shave the failing event off the horizon
+        horizon_us=round(args.horizon * 1e6),
         queue_capacity=args.queue,
         packet_loss_rate=args.loss,
         faults=FaultPlan(
             n_faults=args.faults,
-            t_max_us=int(args.horizon * 0.6e6) or 1,
+            # explicit --fault-tmax keeps fault draws stable when a shrunk
+            # repro command passes a smaller --horizon
+            t_max_us=args.fault_tmax or int(args.horizon * 0.6e6) or 1,
             dur_min_us=100_000,
             dur_max_us=800_000,
         ),
@@ -87,6 +93,27 @@ def cmd_replay(args) -> int:
     return 1 if rp.failed else 0
 
 
+def cmd_shrink(args) -> int:
+    from .engine import shrink
+
+    eng = _build_engine(args)
+    try:
+        sr = shrink(eng, args.seed, max_steps=args.max_steps)
+    except ValueError as exc:
+        print(exc)
+        return 2
+    print(sr.summary())
+    f = sr.shrunk.faults
+    print(
+        f"minimal repro: python -m madsim_tpu replay --machine {args.machine} "
+        f"--seed {args.seed} --nodes {args.nodes} "
+        f"--horizon {sr.shrunk.horizon_us / 1e6} --queue {sr.shrunk.queue_capacity} "
+        f"--faults {f.n_faults} --fault-tmax {f.t_max_us} "
+        f"--loss {sr.shrunk.packet_loss_rate} --max-steps {sr.steps}"
+    )
+    return 0
+
+
 def cmd_check(args) -> int:
     import jax.numpy as jnp
 
@@ -124,6 +151,10 @@ def main(argv=None) -> int:
         p.add_argument("--faults", type=int, default=2)
         p.add_argument("--loss", type=float, default=0.0)
         p.add_argument("--max-steps", type=int, default=3000)
+        p.add_argument(
+            "--fault-tmax", type=int, default=0,
+            help="fault injection window in us (0 = 60%% of horizon)",
+        )
 
     p = sub.add_parser("explore", help="run a seed batch, report failing seeds")
     common(p)
@@ -134,6 +165,10 @@ def main(argv=None) -> int:
     common(p)
     p.add_argument("--tail", type=int, default=30, help="print last N events (0=all)")
     p.set_defaults(fn=cmd_replay)
+
+    p = sub.add_parser("shrink", help="minimize a failing seed's config")
+    common(p)
+    p.set_defaults(fn=cmd_shrink)
 
     p = sub.add_parser("check", help="engine determinism self-check")
     common(p)

@@ -7,6 +7,9 @@ See `core.py` for the architecture. Public surface:
     `run_batch(seeds)`, `failing_seeds(result)`
   * `replay(engine, seed)` — bit-identical single-seed CPU replay
   * `FaultPlan` — randomized partition / kill-restart schedules
+  * `shrink(engine, seed)` — minimize a failing seed's config (shrink.py)
+  * `EngineConfig(trace_ring=R)` + `Engine.ring_trace(result, lane)` —
+    on-device last-R-events ring for post-mortems without replay
 """
 
 from .core import (
@@ -31,7 +34,8 @@ from .machine import (
     set_timer_if,
     update_node,
 )
-from .replay import ReplayResult, TraceEvent, replay, replay_diff
+from .replay import ReplayResult, TraceEvent, decode_ring, replay, replay_diff
+from .shrink import ShrinkResult, shrink
 
 __all__ = [
     "BatchResult",
@@ -50,6 +54,9 @@ __all__ = [
     "update_node",
     "replay",
     "replay_diff",
+    "decode_ring",
+    "shrink",
+    "ShrinkResult",
     "ReplayResult",
     "TraceEvent",
     "EV_TIMER",

@@ -83,6 +83,11 @@ class EngineConfig:
     packet_loss_rate: float = 0.0
     handler_rand_words: int = 4
     faults: FaultPlan = dataclasses.field(default_factory=FaultPlan)
+    # On-device event ring: keep the last `trace_ring` events per lane in
+    # HBM so a failing lane has an immediate post-mortem without a full
+    # replay (0 = off; the ring costs [lanes, trace_ring] masked writes
+    # per step). Contents match the replay trace exactly (tests assert).
+    trace_ring: int = 0
 
 
 @struct.dataclass
@@ -106,6 +111,7 @@ class LaneState:
     clogged: jax.Array  # bool[N, N]
     killed: jax.Array  # bool[N]
     nodes: Any
+    ring: Any  # {} when trace_ring == 0, else dict of [R]/[R,P] arrays
 
 
 @struct.dataclass
@@ -138,6 +144,7 @@ class BatchResult:
     steps: jax.Array
     msg_count: jax.Array
     summary: Any
+    ring: Any  # per-lane event rings ({} unless config.trace_ring > 0)
 
 
 class Engine:
@@ -229,7 +236,21 @@ class Engine:
             clogged=jnp.zeros((n, n), bool),
             killed=jnp.zeros((n,), bool),
             nodes=nodes,
+            ring=self._empty_ring(),
         )
+
+    def _empty_ring(self):
+        r = self.config.trace_ring
+        if not r:
+            return {}
+        return {
+            "step": jnp.full((r,), -1, jnp.int32),
+            "time": jnp.zeros((r,), jnp.int32),
+            "kind": jnp.zeros((r,), jnp.int32),
+            "node": jnp.zeros((r,), jnp.int32),
+            "src": jnp.zeros((r,), jnp.int32),
+            "payload": jnp.zeros((r, self.machine.PAYLOAD_WIDTH), jnp.int32),
+        }
 
     # -- one event per lane --------------------------------------------------
 
@@ -248,6 +269,20 @@ class Engine:
         process = any_valid & ~horizon_hit
         pop_mask = (jnp.arange(s.eq_valid.shape[0]) == idx) & any_valid
         eq_valid = s.eq_valid & ~pop_mask
+
+        # on-device trace ring: record every popped event (same condition
+        # as the replay trace: any_valid, processed or not)
+        ring = s.ring
+        if cfg.trace_ring:
+            slot = (jnp.arange(cfg.trace_ring) == s.step % cfg.trace_ring) & any_valid
+            ring = {
+                "step": jnp.where(slot, s.step, ring["step"]),
+                "time": jnp.where(slot, ev_time, ring["time"]),
+                "kind": jnp.where(slot, ev_kind, ring["kind"]),
+                "node": jnp.where(slot, ev_node, ring["node"]),
+                "src": jnp.where(slot, ev_src, ring["src"]),
+                "payload": jnp.where(slot[:, None], ev_payload[None, :], ring["payload"]),
+            }
 
         # One batched draw covers the step's randomness (handler words,
         # per-message latency + drop draws); k_restart is its own split —
@@ -393,6 +428,7 @@ class Engine:
             clogged=clogged,
             killed=killed,
             nodes=nodes,
+            ring=ring,
         )
 
     # -- batch runners -------------------------------------------------------
@@ -422,6 +458,7 @@ class Engine:
             steps=final.step,
             msg_count=final.msg_count,
             summary=jax.vmap(self.machine.summary)(final.nodes),
+            ring=final.ring,
         )
 
     def run_segment(self, state: LaneState, segment_steps: int) -> LaneState:
@@ -668,6 +705,18 @@ class Engine:
         """Gather the failing lane seeds back to the host
         (the only device->host traffic besides summaries)."""
         return result.seeds[result.failed]
+
+    def ring_trace(self, result, lane: int):
+        """Decode lane `lane`'s on-device event ring into TraceEvents
+        (the last `config.trace_ring` events, oldest first) — immediate
+        post-mortem without a replay. Requires `trace_ring > 0`."""
+        from .replay import decode_ring
+
+        if not self.config.trace_ring:
+            raise ValueError("engine built with trace_ring=0 — no ring recorded")
+        ring = result.ring
+        lane_ring = jax.tree.map(lambda a: a[lane], ring)
+        return decode_ring(lane_ring)
 
     def check_determinism(self, seeds: jax.Array, max_steps: int = 10_000) -> BatchResult:
         """Run the batch twice and require exactly equal results — the

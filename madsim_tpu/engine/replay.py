@@ -91,6 +91,32 @@ def replay_diff(
     return None
 
 
+def decode_ring(lane_ring) -> List[TraceEvent]:
+    """Decode one lane's on-device event ring (Engine.ring_trace) into
+    TraceEvents, oldest first. Entries with step < 0 are unused slots."""
+    import numpy as np
+
+    step = np.asarray(lane_ring["step"])
+    order = np.argsort(step)  # unused (-1) sort first; slice them off
+    order = order[step[order] >= 0]
+    time_us = np.asarray(lane_ring["time"])
+    kinds = np.asarray(lane_ring["kind"])
+    node = np.asarray(lane_ring["node"])
+    src = np.asarray(lane_ring["src"])
+    pay = np.asarray(lane_ring["payload"])
+    return [
+        TraceEvent(
+            step=int(step[i]),
+            time_us=int(time_us[i]),
+            kind=_KIND_NAMES.get(int(kinds[i]), "?"),
+            node=int(node[i]),
+            src=int(src[i]),
+            payload=tuple(int(x) for x in pay[i]),
+        )
+        for i in order
+    ]
+
+
 def replay(
     engine: Engine,
     seed: int,

@@ -1,0 +1,119 @@
+"""Failing-seed shrinking — minimize the reproduction of a flagged seed.
+
+The reference reproduces a failure with `MADSIM_TEST_SEED=N` and the full
+original config; this module goes further and bisects the *config* down
+to a minimal one that still reproduces the same failure code:
+
+  * fewer injected faults (fault i's parameters are drawn from an
+    independent key-chain position, so a plan with n_faults=f keeps the
+    first f faults bit-identical — candidates are honest prefixes)
+  * packet loss off (if it was on)
+  * horizon cut to just past the failure time
+  * step budget cut to just past the failing step
+
+Every candidate is verified by an actual replay; the result reports only
+transformations that kept the SAME fail code. Exposed as
+`python -m madsim_tpu shrink --machine M --seed N ...`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .core import Engine, EngineConfig
+from .replay import ReplayResult, replay
+
+
+@dataclasses.dataclass
+class ShrinkResult:
+    seed: int
+    fail_code: int
+    original: EngineConfig
+    shrunk: EngineConfig
+    steps: int              # events to failure under the shrunk config
+                            # (itself a sufficient --max-steps budget)
+    fail_time_us: int
+    attempts: int           # replays spent shrinking
+
+    def summary(self) -> str:
+        o, s = self.original, self.shrunk
+        parts = []
+        if s.faults.n_faults != o.faults.n_faults:
+            parts.append(f"faults {o.faults.n_faults} -> {s.faults.n_faults}")
+        if s.packet_loss_rate != o.packet_loss_rate:
+            parts.append(f"loss {o.packet_loss_rate} -> 0")
+        if s.horizon_us != o.horizon_us:
+            parts.append(f"horizon {o.horizon_us}us -> {s.horizon_us}us")
+        changed = "; ".join(parts) if parts else "config already minimal"
+        return (
+            f"seed {self.seed} fails with code {self.fail_code} in "
+            f"{self.steps} events (t={self.fail_time_us}us); {changed} "
+            f"[{self.attempts} verification replays]"
+        )
+
+
+def _fails_same(engine: Engine, seed: int, max_steps: int, code: int) -> Optional[ReplayResult]:
+    rp = replay(engine, seed, max_steps=max_steps, trace=False)
+    if rp.failed and rp.fail_code == code:
+        return rp
+    return None
+
+
+def shrink(engine: Engine, seed: int, max_steps: int = 10_000) -> ShrinkResult:
+    """Minimize the failing configuration for `seed`.
+
+    Raises ValueError if the seed does not fail under the given engine.
+    """
+    base = replay(engine, seed, max_steps=max_steps, trace=False)
+    if not base.failed:
+        raise ValueError(
+            f"seed {seed} does not fail under this config (within "
+            f"{max_steps} steps) — nothing to shrink"
+        )
+    code = base.fail_code
+    attempts = 1
+    cfg = engine.config
+    best = base
+
+    # 1. fewest faults whose prefix-plan still reproduces (linear scan from
+    #    zero: the minimal candidate first)
+    for f in range(cfg.faults.n_faults):
+        cand_cfg = dataclasses.replace(
+            cfg, faults=dataclasses.replace(cfg.faults, n_faults=f)
+        )
+        attempts += 1
+        rp = _fails_same(Engine(engine.machine, cand_cfg), seed, max_steps, code)
+        if rp is not None:
+            cfg, best = cand_cfg, rp
+            break
+
+    # 2. packet loss off
+    if cfg.packet_loss_rate > 0:
+        cand_cfg = dataclasses.replace(cfg, packet_loss_rate=0.0)
+        attempts += 1
+        rp = _fails_same(Engine(engine.machine, cand_cfg), seed, max_steps, code)
+        if rp is not None:
+            cfg, best = cand_cfg, rp
+
+    # 3. horizon just past the failure (sound by construction — events at
+    #    t < horizon are unaffected by the horizon value — but verified)
+    fail_t = int(best.state.now_us)
+    if fail_t + 1 < cfg.horizon_us:
+        cand_cfg = dataclasses.replace(cfg, horizon_us=fail_t + 1)
+        attempts += 1
+        rp = _fails_same(Engine(engine.machine, cand_cfg), seed, max_steps, code)
+        if rp is not None:
+            cfg, best = cand_cfg, rp
+
+    # 4. the exact failing step count is itself a sufficient step budget
+    steps = int(best.state.step)
+    return ShrinkResult(
+        seed=seed,
+        fail_code=code,
+        original=engine.config,
+        shrunk=cfg,
+        steps=steps,
+        fail_time_us=int(best.state.now_us),
+        attempts=attempts,
+    )

@@ -125,6 +125,54 @@ def test_bug_variants_flagged_and_replay_bit_identically(machine_cls):
         )
 
 
+def test_trace_ring_matches_replay_exactly():
+    # on-device post-mortem: the last-R-events ring of a failing lane
+    # equals the tail of the bit-identical replay trace
+    cfg = _cfg(trace_ring=32)
+    eng = Engine(DoubleGrantEtcd(4, target_gens=99, target_writes=9999), cfg)
+    res = eng.make_runner(max_steps=4000)(jnp.arange(32, dtype=jnp.uint32))
+    failing = [i for i, f in enumerate(res.failed.tolist()) if f]
+    assert failing, "double-grant produced no failing lane in 32 seeds"
+    lane = failing[0]
+    seed = int(res.seeds[lane])
+
+    ring_events = eng.ring_trace(res, lane)
+    assert 0 < len(ring_events) <= 32
+    rp = replay(eng, seed, max_steps=4000)
+    tail = rp.trace[-len(ring_events):]
+    ring_keys = [(e.step, e.time_us, e.kind, e.node, e.src, e.payload) for e in ring_events]
+    replay_keys = [(e.step, e.time_us, e.kind, e.node, e.src, e.payload) for e in tail]
+    assert ring_keys == replay_keys
+
+
+def test_shrink_minimizes_failing_config():
+    from madsim_tpu.engine import shrink
+
+    cfg = _cfg(horizon_us=8_000_000, packet_loss_rate=0.05)
+    eng = Engine(DoubleGrantEtcd(4, target_gens=99, target_writes=9999), cfg)
+    out = eng.run_stream(64, batch=32, segment_steps=192, seed_start=300, max_steps=6000)
+    assert out["failing"]
+    seed, code = out["failing"][0]
+
+    sr = shrink(eng, seed, max_steps=6000)
+    assert sr.fail_code == code
+    # something was actually minimized, and the shrunk config still fails
+    assert (
+        sr.shrunk.faults.n_faults < cfg.faults.n_faults
+        or sr.shrunk.packet_loss_rate == 0.0
+        or sr.shrunk.horizon_us < cfg.horizon_us
+    )
+    assert sr.steps <= 6000
+    rp = replay(Engine(eng.machine, sr.shrunk), seed, max_steps=sr.steps)
+    assert bool(rp.failed) and int(rp.fail_code) == code
+    assert "seed" in sr.summary()
+
+    # a passing seed refuses to shrink
+    passing = Engine(EtcdMachine(4, target_gens=2, target_writes=6), _cfg())
+    with pytest.raises(ValueError, match="does not fail"):
+        shrink(passing, 0, max_steps=4000)
+
+
 def test_server_restart_with_durable_store_stays_safe():
     # kill/restart the SERVER specifically: durable store => safe.
     # (FaultPlan kills random nodes; with 4 nodes and 3 faults, server
