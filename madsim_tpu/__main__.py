@@ -130,6 +130,55 @@ def cmd_check(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Run an L5 service server over real TCP (production mode) — the
+    counterpart of the reference's real etcd/kafka/S3 endpoints. Apps
+    written against `services.*` clients connect unmodified.
+
+    SECURITY: the wire format is pickle (like the reference real-mode
+    Endpoint uses bincode, but pickle can execute code on load) — bind
+    only on trusted networks / localhost."""
+    from . import dual
+
+    if dual.MODE != "real":
+        sys.exit(
+            "serve needs production networking: re-run as\n"
+            f"  MADSIM_TPU_MODE=real python -m madsim_tpu serve "
+            f"--service {args.service} --addr {args.addr}"
+        )
+    import asyncio
+
+    async def run_server() -> None:
+        if args.service == "etcd":
+            from .services.etcd import SimServer
+
+            server = SimServer()
+        elif args.service == "kafka":
+            from .services.kafka import SimBroker
+
+            server = SimBroker()
+        elif args.service == "s3":
+            from .services.s3 import SimServer as S3Server
+
+            server = S3Server()
+        else:
+            sys.exit(f"unknown service {args.service!r}")
+
+        def on_bound(ep) -> None:
+            # the ready line prints the ACTUAL bound address (supports
+            # --addr host:0) and only after the socket exists
+            host, port = ep.local_addr
+            print(f"{args.service} serving on {host}:{port} (real TCP)", flush=True)
+
+        await server.serve(args.addr, on_bound=on_bound)
+
+    try:
+        asyncio.run(run_server())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_bench(args) -> int:
     import bench  # repo-root bench.py when run from checkout
 
@@ -179,11 +228,21 @@ def main(argv=None) -> int:
     p.add_argument("--lanes", type=int, default=0)
     p.set_defaults(fn=cmd_bench)
 
-    args = parser.parse_args(argv)
-    from ._backend_watchdog import ensure_live_backend
+    p = sub.add_parser(
+        "serve",
+        help="run an L5 service over real TCP (MADSIM_TPU_MODE=real); "
+        "pickle wire format — trusted networks only",
+    )
+    p.add_argument("--service", default="etcd", choices=["etcd", "kafka", "s3"])
+    p.add_argument("--addr", default="127.0.0.1:23790", help="host:port (port 0 = ephemeral)")
+    p.set_defaults(fn=cmd_serve)
 
-    cli_args = list(argv) if argv is not None else sys.argv[1:]
-    ensure_live_backend(argv=["-m", "madsim_tpu"] + cli_args)
+    args = parser.parse_args(argv)
+    if args.cmd != "serve":  # serve never touches jax — skip the probe
+        from ._backend_watchdog import ensure_live_backend
+
+        cli_args = list(argv) if argv is not None else sys.argv[1:]
+        ensure_live_backend(argv=["-m", "madsim_tpu"] + cli_args)
     return args.fn(args)
 
 

@@ -2,8 +2,8 @@
 
 The reference's backbone pattern: every public crate re-exports either
 the real implementation or the sim one depending on the `madsim` cfg
-flag (reference: madsim/src/lib.rs:15-23, madsim-tokio/src/lib.rs:1-8).
-Python selects at import time instead:
+flag (reference: madsim/src/lib.rs:15-23, madsim-tokio/src/lib.rs:1-8,
+madsim-etcd-client/src/lib.rs:1-8). Python selects at import time:
 
     # app.py — identical code for test and production
     from madsim_tpu.dual import net
@@ -11,6 +11,13 @@ Python selects at import time instead:
 
     MADSIM_TPU_MODE=sim  (default) -> simulated fabric, needs a Runtime
     MADSIM_TPU_MODE=real           -> asyncio TCP, runs anywhere
+
+The L5 service clients/servers (`services.etcd/kafka/s3`) are built on
+this facade, so an app using them runs unmodified against a real server
+in real mode (`python -m madsim_tpu serve --service etcd`) — the
+analogue of the reference's L5 crates re-exporting the real client.
+`task`, `time`, and `rand` expose the subset of the sim surface the
+services use, bound to asyncio/stdlib in real mode.
 """
 
 from __future__ import annotations
@@ -21,11 +28,13 @@ MODE = os.environ.get("MADSIM_TPU_MODE", "sim")
 
 if MODE == "real":
     from . import real as net  # noqa: F401  (real.Endpoint)
+    from .real.compat import rand, task, time  # noqa: F401
 
     IS_SIM = False
 else:
     from . import net  # noqa: F401  (sim Endpoint + fabric)
+    from . import rand, task, time  # noqa: F401
 
     IS_SIM = True
 
-__all__ = ["net", "MODE", "IS_SIM"]
+__all__ = ["net", "task", "time", "rand", "MODE", "IS_SIM"]

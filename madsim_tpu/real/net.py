@@ -25,6 +25,11 @@ Addr = Tuple[str, int]
 
 _HDR = struct.Struct("<IQ")  # length (excl. header), tag
 
+# hello-frame tags: the first frame of every connection announces the
+# peer's bound address and the connection kind
+_HELLO_DGRAM = 0   # tag-matched datagram/RPC traffic (multiplexed)
+_HELLO_STREAM = 1  # one connect1 stream (dedicated connection)
+
 
 class _Mailbox:
     """Tag-matched mailbox over asyncio futures (same semantics as the
@@ -54,6 +59,59 @@ class _Mailbox:
         return await fut
 
 
+class PayloadSender:
+    """Sync-send side of a connect1 stream (same surface as the sim
+    net.endpoint.PayloadSender: `send` buffers without awaiting)."""
+
+    def __init__(self, writer: asyncio.StreamWriter, peer_addr: Addr):
+        self._writer = writer
+        self.peer_addr = peer_addr
+        self._closed = False
+
+    def send(self, payload: Any) -> None:
+        from ..net.network import ConnectionReset
+
+        if self._closed or self._writer.is_closing():
+            raise ConnectionReset("send on closed channel")
+        body = pickle.dumps(payload)
+        self._writer.write(_HDR.pack(len(body), 0) + body)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._writer.close()
+
+    def is_closed(self) -> bool:
+        return self._closed or self._writer.is_closing()
+
+
+class PayloadReceiver:
+    """Async-recv side of a connect1 stream; EOF -> None (sim parity)."""
+
+    def __init__(self, reader: asyncio.StreamReader, peer_addr: Addr):
+        self._reader = reader
+        self.peer_addr = peer_addr
+
+    async def recv(self) -> Any:
+        from ..net.network import ConnectionReset
+
+        try:
+            hdr = await self._reader.readexactly(_HDR.size)
+        except asyncio.IncompleteReadError as exc:
+            if not exc.partial:
+                return None  # clean EOF == channel closed (sim parity)
+            raise ConnectionReset("connection reset mid-frame") from exc
+        except ConnectionResetError as exc:
+            # sim parity: a broken connection raises, only a clean close
+            # returns None
+            raise ConnectionReset("connection reset by peer") from exc
+        length, _tag = _HDR.unpack(hdr)
+        try:
+            return pickle.loads(await self._reader.readexactly(length))
+        except (asyncio.IncompleteReadError, ConnectionResetError) as exc:
+            raise ConnectionReset("connection reset mid-frame") from exc
+
+
 class Endpoint:
     """Real-mode Endpoint with the sim Endpoint's surface."""
 
@@ -63,8 +121,9 @@ class Endpoint:
         self._mailbox = _Mailbox()
         self._peers: Dict[Addr, asyncio.StreamWriter] = {}
         self._conn_locks: Dict[Addr, asyncio.Lock] = defaultdict(asyncio.Lock)
-        self._reader_tasks: List[asyncio.Task] = []
+        self._reader_tasks: set = set()  # pruned on completion
         self._handler_tasks: set = set()
+        self._accept_queue: asyncio.Queue = asyncio.Queue()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -104,13 +163,22 @@ class Endpoint:
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         task = asyncio.current_task()
         if task is not None:
-            self._reader_tasks.append(task)
+            self._reader_tasks.add(task)
+            task.add_done_callback(self._reader_tasks.discard)
+        keep_open = False
         try:
-            # peer announces its *bound* address first (so replies route to
-            # the listener, not the ephemeral connect port)
+            # peer announces its *bound* address + connection kind first
+            # (so replies route to the listener, not the ephemeral port)
             hdr = await reader.readexactly(_HDR.size)
-            length, _tag = _HDR.unpack(hdr)
+            length, hello_tag = _HDR.unpack(hdr)
             frm: Addr = tuple(pickle.loads(await reader.readexactly(length)))  # type: ignore[assignment]
+            if hello_tag == _HELLO_STREAM:
+                # a connect1 stream: hand the connection to accept1()
+                tx = PayloadSender(writer, frm)
+                rx = PayloadReceiver(reader, frm)
+                self._accept_queue.put_nowait((tx, rx, frm))
+                keep_open = True
+                return
             while True:
                 hdr = await reader.readexactly(_HDR.size)
                 length, tag = _HDR.unpack(hdr)
@@ -119,7 +187,8 @@ class Endpoint:
         except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
             pass
         finally:
-            writer.close()
+            if not keep_open:
+                writer.close()
 
     async def _conn_to(self, dst: Addr) -> asyncio.StreamWriter:
         writer = self._peers.get(dst)
@@ -151,6 +220,22 @@ class Endpoint:
         return await self._mailbox.recv(tag)
 
     recv_from_raw = recv_from
+
+    # -- connection API (sim parity: endpoint.rs connect1/accept1) -----------
+
+    async def connect1(self, dst: Any) -> Tuple[PayloadSender, PayloadReceiver]:
+        """Open a reliable bidirectional stream: one dedicated TCP
+        connection, length-delimited pickled payloads."""
+        d = parse_addr(dst)
+        reader, writer = await asyncio.open_connection(d[0], d[1])
+        hello = pickle.dumps(self.local_addr)
+        writer.write(_HDR.pack(len(hello), _HELLO_STREAM) + hello)
+        await writer.drain()
+        return PayloadSender(writer, d), PayloadReceiver(reader, d)
+
+    async def accept1(self) -> Tuple[PayloadSender, PayloadReceiver, Addr]:
+        """Accept one incoming connect1 stream."""
+        return await self._accept_queue.get()
 
     # -- RPC (reference: std/net/rpc.rs) -------------------------------------
 

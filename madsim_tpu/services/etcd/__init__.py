@@ -14,11 +14,14 @@ from __future__ import annotations
 
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
-from ... import time as sim_time
+from ...dual import rand, time as sim_time  # mode-selected (sim or asyncio)
 from ...errors import SimError
-from ...net import Endpoint
 from ...net.network import ConnectionReset, parse_addr
-from ...task import spawn
+from ...dual import net as _dual_net
+from ...dual import task as _dual_task
+
+Endpoint = _dual_net.Endpoint
+spawn = _dual_task.spawn
 from .service import EtcdError, EtcdService, Event, KeyValue, MAX_REQUEST_BYTES
 
 __all__ = [
@@ -116,12 +119,12 @@ class SimServer:
         self.timeout_rate = timeout_rate
         self.service: Optional[EtcdService] = None
 
-    async def serve(self, addr: Any) -> None:
-        import madsim_tpu.rand as rand
-
+    async def serve(self, addr: Any, on_bound=None) -> None:
         rng = rand.thread_rng()
         self.service = EtcdService(rng)
         ep = await Endpoint.bind(addr)
+        if on_bound is not None:
+            on_bound(ep)
 
         async def ticker():
             # 1 s lease countdown (reference: service.rs:25-35)
@@ -136,8 +139,6 @@ class SimServer:
             spawn(self._handle(tx, rx), name="etcd-conn")
 
     async def _handle(self, tx, rx) -> None:
-        import madsim_tpu.rand as rand
-
         svc = self.service
         rng = rand.thread_rng()
         try:
@@ -161,6 +162,8 @@ class SimServer:
                 tx.send(("err", str(e)))
         except ConnectionReset:
             pass
+        finally:
+            tx.close()  # real mode: one fd per request must not linger
 
     def _apply(self, svc: EtcdService, req: tuple):
         kind = req[0]

@@ -18,12 +18,15 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
-from ... import time as sim_time
+from ...dual import rand, time as sim_time  # mode-selected (sim or asyncio)
 from ...errors import SimError
-from ...net import Endpoint
 from ...net.network import ConnectionReset, parse_addr
+from ...dual import net as _dual_net
+from ...dual import task as _dual_task
+
+Endpoint = _dual_net.Endpoint
+spawn = _dual_task.spawn
 from ...net.rpc import hash_str
-from ...task import spawn
 
 __all__ = [
     "KafkaError",
@@ -166,8 +169,10 @@ class SimBroker:
     def __init__(self) -> None:
         self.broker = Broker()
 
-    async def serve(self, addr: Any) -> None:
+    async def serve(self, addr: Any, on_bound=None) -> None:
         ep = await Endpoint.bind(addr)
+        if on_bound is not None:
+            on_bound(ep)
         while True:
             tx, rx, _peer = await ep.accept1()
             spawn(self._handle(tx, rx), name="kafka-conn")
@@ -198,6 +203,8 @@ class SimBroker:
                     tx.send(("err", str(e)))
         except ConnectionReset:
             pass
+        finally:
+            tx.close()  # real mode: one fd per connection must not linger
 
 
 # -- client config (reference: src/sim/config.rs) -------------------------------
@@ -341,7 +348,6 @@ class DeliveryFuture:
     awaiter, not as a simulation-aborting task panic."""
 
     def __init__(self, coro):
-        from ...task import spawn
 
         async def captured():
             try:

@@ -14,11 +14,14 @@ from __future__ import annotations
 import hashlib
 from typing import Any, Dict, List, Optional, Tuple
 
-from ... import time as sim_time
+from ...dual import rand, time as sim_time  # mode-selected (sim or asyncio)
 from ...errors import SimError
-from ...net import Endpoint
 from ...net.network import ConnectionReset, parse_addr
-from ...task import spawn
+from ...dual import net as _dual_net
+from ...dual import task as _dual_task
+
+Endpoint = _dual_net.Endpoint
+spawn = _dual_task.spawn
 
 __all__ = ["S3Error", "S3Service", "SimServer", "Client", "Config"]
 
@@ -164,11 +167,11 @@ class SimServer:
     def __init__(self) -> None:
         self.service: Optional[S3Service] = None
 
-    async def serve(self, addr: Any) -> None:
-        import madsim_tpu.rand as rand
-
+    async def serve(self, addr: Any, on_bound=None) -> None:
         self.service = S3Service(rand.thread_rng())
         ep = await Endpoint.bind(addr)
+        if on_bound is not None:
+            on_bound(ep)
         while True:
             tx, rx, _peer = await ep.accept1()
             spawn(self._handle(tx, rx), name="s3-conn")
@@ -189,6 +192,8 @@ class SimServer:
                     tx.send(("err", (e.code, e.message)))
         except ConnectionReset:
             pass
+        finally:
+            tx.close()  # real mode: one fd per connection must not linger
 
 
 # -- client --------------------------------------------------------------------

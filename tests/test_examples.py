@@ -31,6 +31,32 @@ def test_chaos_pipeline_example_deterministic():
     assert "evt-after-crash" in r1.stdout
 
 
+def test_etcd_dual_example_sim_mode():
+    r = _run("etcd_dual.py")
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "[sim]" in r.stdout and "'txn_succeeded': True" in r.stdout
+
+
+def test_etcd_dual_example_real_mode():
+    # the SAME app bytes over real TCP against a real served endpoint
+    from test_real_mode import start_real_server
+
+    env = dict(os.environ)
+    env["MADSIM_TPU_MODE"] = "real"
+    env["PYTHONPATH"] = _REPO
+    server, addr = start_real_server("etcd", _REPO, env)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "examples", "etcd_dual.py"), addr],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "[real]" in r.stdout and "'txn_succeeded': True" in r.stdout
+    finally:
+        server.kill()
+        server.wait()
+
+
 def test_bug_hunt_example():
     r = _run("bug_hunt.py")
     assert r.returncode == 0, r.stderr[-500:]
