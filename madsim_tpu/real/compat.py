@@ -53,7 +53,9 @@ class time:
     class Interval:
         def __init__(self, period: float):
             self.period = period
-            self._next = _pytime.monotonic() + period
+            # first tick completes immediately — tokio/sim parity
+            # (madsim_tpu.time.interval docstring guarantees it)
+            self._next = _pytime.monotonic()
 
         async def tick(self) -> None:
             delay = self._next - _pytime.monotonic()
@@ -67,11 +69,14 @@ class time:
 
     @staticmethod
     def now() -> float:
-        return _pytime.monotonic()
+        # wall clock, NOT monotonic: services stamp kafka message
+        # timestamps / S3 last_modified with this, which must be epoch
+        # time comparable across hosts in production mode
+        return _pytime.time()
 
     @staticmethod
     def now_ns() -> int:
-        return _pytime.monotonic_ns()
+        return _pytime.time_ns()
 
 
 class _RealRng:

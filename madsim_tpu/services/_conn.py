@@ -1,0 +1,84 @@
+"""Broker-connection strategy shared by the kafka/s3 service clients.
+
+Sim mode: one fresh connect1 channel per request. Channels are free in
+the simulator, and a timed-out/abandoned call then abandons only its own
+channel — no request/response correlation needed, concurrent callers
+cannot desynchronize.
+
+Real mode: one PERSISTENT stream guarded by a lock (the per-call pattern
+would churn a real TCP connection per request — an idle consumer poll
+loop alone would cycle ~100 sockets/sec into TIME_WAIT). The stream is
+reopened once on failure; a cancellation mid-call (timeout) drops the
+stream so a late response can never be mis-paired with the next request.
+
+The servers already speak both shapes: their handlers loop
+`while (req := await rx.recv()) is not None`, serving one connection for
+one or many requests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..dual import IS_SIM, net as _dual_net
+from ..net.network import ConnectionReset
+
+Endpoint = _dual_net.Endpoint
+
+
+class StreamCaller:
+    """`await call(req) -> response payload | None` (None = unavailable)."""
+
+    def __init__(self) -> None:
+        self._ep = None
+        self._addr = None
+        self._stream = None  # (tx, rx), real mode only
+        self._lock = None
+
+    async def open(self, addr) -> None:
+        self._ep = await Endpoint.bind(("0.0.0.0", 0))
+        self._addr = addr
+        if not IS_SIM:
+            import asyncio
+
+            self._lock = asyncio.Lock()
+
+    async def call(self, req: tuple) -> Optional[Any]:
+        if IS_SIM:
+            tx, rx = await self._ep.connect1(self._addr)
+            try:
+                tx.send(req)
+                return await rx.recv()
+            finally:
+                tx.close()
+
+        async with self._lock:
+            try:
+                for attempt in (0, 1):
+                    if self._stream is None:
+                        self._stream = await self._ep.connect1(self._addr)
+                    tx, rx = self._stream
+                    try:
+                        tx.send(req)
+                        rsp = await rx.recv()
+                    except ConnectionReset:
+                        rsp = None
+                    if rsp is None:
+                        self._drop_stream()
+                        continue  # reopen once (attempt 1), else fall out
+                    return rsp
+                return None
+            except BaseException:
+                # cancellation (call timeout) or unexpected error mid-call:
+                # the stream may carry an unconsumed response — drop it
+                self._drop_stream()
+                raise
+
+    def _drop_stream(self) -> None:
+        if self._stream is not None:
+            tx, _rx = self._stream
+            try:
+                tx.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+            self._stream = None

@@ -27,9 +27,11 @@ from ...dual import task as _dual_task
 Endpoint = _dual_net.Endpoint
 spawn = _dual_task.spawn
 from ...net.rpc import hash_str
+from .._conn import StreamCaller
 
 __all__ = [
     "KafkaError",
+    "ErrorCode",
     "Broker",
     "SimBroker",
     "ClientConfig",
@@ -44,22 +46,41 @@ __all__ = [
 ]
 
 
+class ErrorCode:
+    """rdkafka-style error codes (reference: RDKafkaErrorCode; apps match
+    on these, not on message strings)."""
+
+    UNKNOWN_TOPIC_OR_PART = "UnknownTopicOrPartition"
+    TOPIC_ALREADY_EXISTS = "TopicAlreadyExists"
+    MSG_SIZE_TOO_LARGE = "MessageSizeTooLarge"
+    OFFSET_OUT_OF_RANGE = "OffsetOutOfRange"
+    INVALID_ARG = "InvalidArgument"
+    TIMED_OUT = "TimedOut"
+    INVALID_TXN_STATE = "InvalidTxnState"
+    UNKNOWN_GROUP = "UnknownGroup"
+    FAIL = "Fail"
+
+
 class KafkaError(SimError):
-    pass
+    def __init__(self, message: str, code: str = ErrorCode.FAIL):
+        super().__init__(message)
+        self.code = code
 
 
 class Message:
-    """A delivered record (reference: BorrowedMessage surface)."""
+    """A delivered record (reference: BorrowedMessage surface, incl.
+    headers — src/sim/producer records carry OwnedHeaders)."""
 
-    __slots__ = ("topic", "partition", "offset", "key", "payload", "timestamp")
+    __slots__ = ("topic", "partition", "offset", "key", "payload", "timestamp", "headers")
 
-    def __init__(self, topic: str, partition: int, offset: int, key: Optional[bytes], payload: Optional[bytes], timestamp: int):
+    def __init__(self, topic: str, partition: int, offset: int, key: Optional[bytes], payload: Optional[bytes], timestamp: int, headers: Optional[List[Tuple[str, bytes]]] = None):
         self.topic = topic
         self.partition = partition
         self.offset = offset
         self.key = key
         self.payload = payload
         self.timestamp = timestamp
+        self.headers = headers or []
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"Message({self.topic}[{self.partition}]@{self.offset})"
@@ -70,6 +91,7 @@ class Offset:
 
     Beginning = "beginning"
     End = "end"
+    Stored = "stored"  # the group's committed offset (needs group.id)
 
     @staticmethod
     def at(n: int) -> int:
@@ -89,8 +111,8 @@ class Partition:
     __slots__ = ("records",)
 
     def __init__(self) -> None:
-        # list of (key, payload, timestamp_ms); offset == index
-        self.records: List[Tuple[Optional[bytes], Optional[bytes], int]] = []
+        # list of (key, payload, timestamp_ms, headers); offset == index
+        self.records: List[Tuple[Optional[bytes], Optional[bytes], int, list]] = []
 
     @property
     def high_watermark(self) -> int:
@@ -98,49 +120,66 @@ class Partition:
 
 
 class Broker:
-    """Reference: broker.rs:12-60."""
+    """Reference: broker.rs:12-60 (+ committed-offset store, the
+    group-coordinator subset: one member per group, no rebalancing)."""
 
-    def __init__(self) -> None:
+    def __init__(self, message_max_bytes: int = 1_000_000) -> None:
         self.topics: Dict[str, List[Partition]] = {}
         self._rr: Dict[str, int] = {}
+        self.message_max_bytes = message_max_bytes
+        # (group, topic, partition) -> committed offset
+        self.committed_offsets: Dict[Tuple[str, str, int], int] = {}
 
     def create_topic(self, name: str, partitions: int) -> None:
         if name in self.topics:
-            raise KafkaError(f"topic already exists: {name}")
+            raise KafkaError(
+                f"topic already exists: {name}", ErrorCode.TOPIC_ALREADY_EXISTS
+            )
+        if partitions < 1:
+            raise KafkaError("partitions must be >= 1", ErrorCode.INVALID_ARG)
         self.topics[name] = [Partition() for _ in range(partitions)]
         self._rr[name] = 0
 
     def _partition(self, topic: str, partition: int) -> Partition:
         parts = self.topics.get(topic)
         if parts is None:
-            raise KafkaError(f"unknown topic: {topic}")
+            raise KafkaError(f"unknown topic: {topic}", ErrorCode.UNKNOWN_TOPIC_OR_PART)
         if not (0 <= partition < len(parts)):
-            raise KafkaError(f"unknown partition: {topic}[{partition}]")
+            raise KafkaError(
+                f"unknown partition: {topic}[{partition}]",
+                ErrorCode.UNKNOWN_TOPIC_OR_PART,
+            )
         return parts[partition]
 
     def pick_partition(self, topic: str, key: Optional[bytes]) -> int:
         parts = self.topics.get(topic)
         if parts is None:
-            raise KafkaError(f"unknown topic: {topic}")
+            raise KafkaError(f"unknown topic: {topic}", ErrorCode.UNKNOWN_TOPIC_OR_PART)
         if key is not None:
             return hash_str(key.decode("latin1")) % len(parts)
         idx = self._rr[topic] % len(parts)
         self._rr[topic] += 1
         return idx
 
-    def produce(self, topic: str, partition: Optional[int], key: Optional[bytes], payload: Optional[bytes], ts_ms: int) -> Tuple[int, int]:
+    def produce(self, topic: str, partition: Optional[int], key: Optional[bytes], payload: Optional[bytes], ts_ms: int, headers: Optional[list] = None) -> Tuple[int, int]:
+        size = len(key or b"") + len(payload or b"")
+        if size > self.message_max_bytes:
+            raise KafkaError(
+                f"message size {size} > message.max.bytes {self.message_max_bytes}",
+                ErrorCode.MSG_SIZE_TOO_LARGE,
+            )
         if partition is None or partition < 0:
             partition = self.pick_partition(topic, key)
         part = self._partition(topic, partition)
-        part.records.append((key, payload, ts_ms))
+        part.records.append((key, payload, ts_ms, list(headers or [])))
         return partition, len(part.records) - 1
 
     def fetch(self, topic: str, partition: int, offset: int, max_records: int) -> List[Message]:
         part = self._partition(topic, partition)
         out = []
         for off in range(max(0, offset), min(len(part.records), offset + max_records)):
-            key, payload, ts = part.records[off]
-            out.append(Message(topic, partition, off, key, payload, ts))
+            key, payload, ts, headers = part.records[off]
+            out.append(Message(topic, partition, off, key, payload, ts, headers))
         return out
 
     def watermarks(self, topic: str, partition: int) -> Tuple[int, int]:
@@ -151,7 +190,7 @@ class Broker:
         """First offset with timestamp >= ts_ms (reference: broker.rs
         timestamp->offset lookup)."""
         part = self._partition(topic, partition)
-        for off, (_k, _p, ts) in enumerate(part.records):
+        for off, (_k, _p, ts, _h) in enumerate(part.records):
             if ts >= ts_ms:
                 return off
         return None
@@ -159,15 +198,32 @@ class Broker:
     def metadata(self) -> Dict[str, int]:
         return {name: len(parts) for name, parts in self.topics.items()}
 
+    # -- committed offsets (the consumer-group subset) --
+
+    def commit_offsets(self, group: str, offsets: Dict[Tuple[str, int], int]) -> None:
+        if not group:
+            raise KafkaError("group.id required to commit", ErrorCode.UNKNOWN_GROUP)
+        for (topic, partition), off in offsets.items():
+            self._partition(topic, partition)  # validates
+            self.committed_offsets[(group, topic, partition)] = off
+
+    def committed(self, group: str, topic: str, partition: int) -> Optional[int]:
+        self._partition(topic, partition)
+        return self.committed_offsets.get((group, topic, partition))
+
 
 # -- server --------------------------------------------------------------------
 
 
 class SimBroker:
-    """Reference: sim_broker.rs:14-77."""
+    """Reference: sim_broker.rs:14-77.
 
-    def __init__(self) -> None:
-        self.broker = Broker()
+    `message_max_bytes` is the broker-side limit (like a real broker's
+    message.max.bytes); the client's ClientConfig key of the same name is
+    its own produce-time check — raise BOTH to ship larger messages."""
+
+    def __init__(self, message_max_bytes: int = 1_000_000) -> None:
+        self.broker = Broker(message_max_bytes=message_max_bytes)
 
     async def serve(self, addr: Any, on_bound=None) -> None:
         ep = await Endpoint.bind(addr)
@@ -187,7 +243,7 @@ class SimBroker:
                         b.create_topic(req[1], req[2])
                         rsp: Any = None
                     elif kind == "produce":
-                        rsp = b.produce(req[1], req[2], req[3], req[4], req[5])
+                        rsp = b.produce(req[1], req[2], req[3], req[4], req[5], req[6])
                     elif kind == "fetch":
                         rsp = b.fetch(req[1], req[2], req[3], req[4])
                     elif kind == "metadata":
@@ -196,11 +252,16 @@ class SimBroker:
                         rsp = b.watermarks(req[1], req[2])
                     elif kind == "offsets_for_time":
                         rsp = b.offsets_for_time(req[1], req[2], req[3])
+                    elif kind == "commit_offsets":
+                        b.commit_offsets(req[1], req[2])
+                        rsp = None
+                    elif kind == "committed":
+                        rsp = b.committed(req[1], req[2], req[3])
                     else:
-                        raise KafkaError(f"unknown request {kind}")
+                        raise KafkaError(f"unknown request {kind}", ErrorCode.INVALID_ARG)
                     tx.send(("ok", rsp))
                 except KafkaError as e:
-                    tx.send(("err", str(e)))
+                    tx.send(("err", (e.code, str(e))))
         except ConnectionReset:
             pass
         finally:
@@ -224,7 +285,7 @@ class ClientConfig:
     def _addr(self):
         servers = self.conf.get("bootstrap.servers")
         if not servers:
-            raise KafkaError("bootstrap.servers not set")
+            raise KafkaError("bootstrap.servers not set", ErrorCode.INVALID_ARG)
         return parse_addr(servers.split(",")[0])
 
     async def create_base_producer(self) -> "BaseProducer":
@@ -248,31 +309,24 @@ class ClientConfig:
 
 
 class _Conn:
-    """Broker connection handle. Each call opens its own connect1 stream,
-    so a timed-out/aborted call abandons only its own channel — no
-    request/response correlation needed and concurrent DeliveryFutures
-    cannot desynchronize responses."""
+    """Broker connection handle over the shared StreamCaller (per-call
+    channels in sim; a persistent locked stream in real mode — see
+    services/_conn.py for the rationale)."""
 
     def __init__(self) -> None:
-        self._ep = None
-        self._addr = None
+        self._caller = StreamCaller()
 
     async def open(self, addr) -> None:
-        self._ep = await Endpoint.bind(("0.0.0.0", 0))
-        self._addr = addr
+        await self._caller.open(addr)
 
     async def call(self, req: tuple):
-        tx, rx = await self._ep.connect1(self._addr)
-        try:
-            tx.send(req)
-            rsp = await rx.recv()
-        finally:
-            tx.close()
+        rsp = await self._caller.call(req)
         if rsp is None:
-            raise KafkaError("broker unavailable")
+            raise KafkaError("broker unavailable", ErrorCode.TIMED_OUT)
         status, payload = rsp
         if status == "err":
-            raise KafkaError(payload)
+            code, msg = payload
+            raise KafkaError(msg, code)
         return payload
 
 
@@ -280,14 +334,16 @@ class _Conn:
 
 
 class BaseRecord:
-    """Reference: rdkafka BaseRecord/FutureRecord."""
+    """Reference: rdkafka BaseRecord/FutureRecord (+ OwnedHeaders as a
+    plain list of (name, value) pairs)."""
 
-    def __init__(self, topic: str, key: Optional[bytes] = None, payload: Optional[bytes] = None, partition: Optional[int] = None, timestamp: Optional[int] = None):
+    def __init__(self, topic: str, key: Optional[bytes] = None, payload: Optional[bytes] = None, partition: Optional[int] = None, timestamp: Optional[int] = None, headers: Optional[List[Tuple[str, bytes]]] = None):
         self.topic = topic
         self.key = key
         self.payload = payload
         self.partition = partition
         self.timestamp = timestamp
+        self.headers = list(headers or [])
 
 
 FutureRecord = BaseRecord
@@ -302,14 +358,27 @@ class BaseProducer:
         self._conn = _Conn()
         self._buffer: List[BaseRecord] = []
         self._in_txn = False
+        self._max_bytes = 1_000_000
 
     @staticmethod
     async def _create(cfg: ClientConfig) -> "BaseProducer":
         p = BaseProducer()
         await p._conn.open(cfg._addr())
+        # rdkafka rejects oversized messages at produce() time, before
+        # any broker round trip (config: message.max.bytes)
+        p._max_bytes = int(cfg.get("message.max.bytes", "1000000"))
         return p
 
+    def _check_size(self, record: BaseRecord) -> None:
+        size = len(record.key or b"") + len(record.payload or b"")
+        if size > self._max_bytes:
+            raise KafkaError(
+                f"message size {size} > message.max.bytes {self._max_bytes}",
+                ErrorCode.MSG_SIZE_TOO_LARGE,
+            )
+
     def send(self, record: BaseRecord) -> None:
+        self._check_size(record)
         self._buffer.append(record)
 
     async def flush(self) -> List[Tuple[int, int]]:
@@ -317,7 +386,7 @@ class BaseProducer:
         buffered, self._buffer = self._buffer, []
         for r in buffered:
             ts = r.timestamp if r.timestamp is not None else int(sim_time.now() * 1000)
-            out.append(await self._conn.call(("produce", r.topic, r.partition, r.key, r.payload, ts)))
+            out.append(await self._conn.call(("produce", r.topic, r.partition, r.key, r.payload, ts, r.headers)))
         return out
 
     # fake transactions (reference: base_producer.rs transactions are
@@ -327,12 +396,12 @@ class BaseProducer:
 
     def begin_transaction(self) -> None:
         if self._in_txn:
-            raise KafkaError("transaction already in progress")
+            raise KafkaError("transaction already in progress", ErrorCode.INVALID_TXN_STATE)
         self._in_txn = True
 
     async def commit_transaction(self) -> None:
         if not self._in_txn:
-            raise KafkaError("no transaction in progress")
+            raise KafkaError("no transaction in progress", ErrorCode.INVALID_TXN_STATE)
         await self.flush()
         self._in_txn = False
 
@@ -375,8 +444,9 @@ class FutureProducer:
 
     def send(self, record: BaseRecord, timeout: Optional[float] = None) -> DeliveryFuture:
         async def deliver():
+            self._inner._check_size(record)
             ts = record.timestamp if record.timestamp is not None else int(sim_time.now() * 1000)
-            call = self._inner._conn.call(("produce", record.topic, record.partition, record.key, record.payload, ts))
+            call = self._inner._conn.call(("produce", record.topic, record.partition, record.key, record.payload, ts, record.headers))
             if timeout is not None:
                 return await sim_time.timeout(timeout, call)
             return await call
@@ -398,25 +468,46 @@ class BaseConsumer:
         # (topic, partition) -> next offset
         self._positions: Dict[Tuple[str, int], int] = {}
         self._poll_interval = 0.01
+        self._group = ""
+        self._auto_commit = True
+        self._auto_reset = "earliest"
 
     @staticmethod
     async def _create(cfg: ClientConfig) -> "BaseConsumer":
         c = BaseConsumer()
         await c._conn.open(cfg._addr())
         c._auto_reset = cfg.get("auto.offset.reset", "earliest")
+        c._group = cfg.get("group.id", "")
+        c._auto_commit = cfg.get("enable.auto.commit", "true") not in ("false", "0")
         return c
 
     async def subscribe(self, topics: Sequence[str]) -> None:
-        """Assign all partitions of the topics (the sim has no consumer
-        groups, like the reference's manual-assign model)."""
+        """Assign all partitions of the topics. With a `group.id`, each
+        partition resumes from the group's committed offset when one
+        exists, else from `auto.offset.reset` (the single-member
+        consumer-group subset: offsets persist at the broker, but there
+        is no rebalancing across members)."""
         meta = await self._conn.call(("metadata",))
         for t in topics:
             if t not in meta:
-                raise KafkaError(f"unknown topic: {t}")
+                raise KafkaError(f"unknown topic: {t}", ErrorCode.UNKNOWN_TOPIC_OR_PART)
             for partid in range(meta[t]):
-                await self.assign(t, partid, Offset.Beginning if self._auto_reset == "earliest" else Offset.End)
+                start: Union[str, int] = (
+                    Offset.Stored
+                    if self._group
+                    else (Offset.Beginning if self._auto_reset == "earliest" else Offset.End)
+                )
+                await self.assign(t, partid, start)
 
     async def assign(self, topic: str, partition: int, offset: Union[str, int] = Offset.Beginning) -> None:
+        if offset == Offset.Stored:
+            if not self._group:
+                raise KafkaError("Offset.Stored needs group.id", ErrorCode.UNKNOWN_GROUP)
+            stored = await self._conn.call(("committed", self._group, topic, partition))
+            if stored is not None:
+                self._positions[(topic, partition)] = stored
+                return
+            offset = Offset.Beginning if self._auto_reset == "earliest" else Offset.End
         lo, hi = await self._conn.call(("watermarks", topic, partition))
         if offset == Offset.Beginning:
             pos = lo
@@ -428,17 +519,37 @@ class BaseConsumer:
 
     async def seek(self, topic: str, partition: int, offset: Union[str, int]) -> None:
         if (topic, partition) not in self._positions:
-            raise KafkaError(f"not assigned: {topic}[{partition}]")
+            raise KafkaError(f"not assigned: {topic}[{partition}]", ErrorCode.INVALID_ARG)
         await self.assign(topic, partition, offset)
 
+    # -- committed offsets (consumer-group subset) --
+
+    async def commit(self) -> None:
+        """Commit current positions to the broker for this group.id."""
+        if not self._group:
+            raise KafkaError("commit needs group.id", ErrorCode.UNKNOWN_GROUP)
+        await self._conn.call(("commit_offsets", self._group, dict(self._positions)))
+
+    async def committed(self, topic: str, partition: int) -> Optional[int]:
+        if not self._group:
+            raise KafkaError("committed needs group.id", ErrorCode.UNKNOWN_GROUP)
+        return await self._conn.call(("committed", self._group, topic, partition))
+
     async def poll(self, timeout: Optional[float] = None) -> Optional[Message]:
-        """Next message across assigned partitions, or None on timeout."""
+        """Next message across assigned partitions, or None on timeout.
+        With group.id + enable.auto.commit, the new position is committed
+        after each delivered message (interval-batching simplified to
+        per-message; same observable at-least-once semantics)."""
         deadline = sim_time.now() + timeout if timeout is not None else None
         while True:
             for (topic, part), pos in sorted(self._positions.items()):
                 msgs = await self._conn.call(("fetch", topic, part, pos, 1))
                 if msgs:
                     self._positions[(topic, part)] = msgs[0].offset + 1
+                    if self._group and self._auto_commit:
+                        await self._conn.call(
+                            ("commit_offsets", self._group, {(topic, part): msgs[0].offset + 1})
+                        )
                     return msgs[0]
             if deadline is not None and sim_time.now() >= deadline:
                 return None

@@ -19,6 +19,7 @@ from ...errors import SimError
 from ...net.network import ConnectionReset, parse_addr
 from ...dual import net as _dual_net
 from ...dual import task as _dual_task
+from .._conn import StreamCaller
 
 Endpoint = _dual_net.Endpoint
 spawn = _dual_task.spawn
@@ -34,12 +35,16 @@ class S3Error(SimError):
 
 
 class _Object:
-    __slots__ = ("body", "last_modified", "etag")
+    __slots__ = ("body", "last_modified", "etag", "content_type", "metadata")
 
-    def __init__(self, body: bytes, last_modified: float):
+    def __init__(self, body: bytes, last_modified: float,
+                 content_type: str = "binary/octet-stream",
+                 metadata: Optional[Dict[str, str]] = None):
         self.body = body
         self.last_modified = last_modified
         self.etag = hashlib.md5(body).hexdigest()
+        self.content_type = content_type
+        self.metadata = dict(metadata or {})
 
 
 class S3Service:
@@ -71,19 +76,53 @@ class S3Service:
         del self.buckets[bucket]
         return {}
 
-    def put_object(self, bucket: str, key: str, body: bytes, now: float) -> dict:
+    def put_object(self, bucket: str, key: str, body: bytes, now: float,
+                   content_type: Optional[str] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> dict:
         b = self._bucket(bucket)
-        obj = _Object(bytes(body), now)
+        obj = _Object(bytes(body), now,
+                      content_type=content_type or "binary/octet-stream",
+                      metadata=metadata)
         b[key] = obj
         return {"e_tag": obj.etag}
 
-    def get_object(self, bucket: str, key: str) -> dict:
+    @staticmethod
+    def _parse_range(spec: str, size: int) -> Tuple[int, int]:
+        """HTTP range header subset: bytes=a-b | bytes=a- | bytes=-n."""
+        if not spec.startswith("bytes="):
+            raise S3Error("InvalidRange", spec)
+        lo_s, _, hi_s = spec[len("bytes="):].partition("-")
+        try:
+            if lo_s == "":  # suffix form: last n bytes (n must be > 0)
+                n = int(hi_s)
+                if n <= 0 or size == 0:
+                    raise S3Error("InvalidRange", f"{spec} for object of {size} bytes")
+                return max(0, size - n), size - 1
+            lo = int(lo_s)
+            hi = int(hi_s) if hi_s else size - 1
+        except ValueError as exc:
+            raise S3Error("InvalidRange", spec) from exc
+        if lo > hi or lo >= size:
+            raise S3Error("InvalidRange", f"{spec} for object of {size} bytes")
+        return lo, min(hi, size - 1)
+
+    def get_object(self, bucket: str, key: str, range: Optional[str] = None) -> dict:
         b = self._bucket(bucket)
         if key not in b:
             raise S3Error("NoSuchKey", key)
         obj = b[key]
-        return {"body": obj.body, "e_tag": obj.etag, "last_modified": obj.last_modified,
-                "content_length": len(obj.body)}
+        body = obj.body
+        out = {"e_tag": obj.etag, "last_modified": obj.last_modified,
+               "content_type": obj.content_type, "metadata": dict(obj.metadata)}
+        if range is not None:
+            lo, hi = self._parse_range(range, len(body))
+            out["body"] = body[lo:hi + 1]
+            out["content_length"] = hi - lo + 1
+            out["content_range"] = f"bytes {lo}-{hi}/{len(body)}"
+        else:
+            out["body"] = body
+            out["content_length"] = len(body)
+        return out
 
     def head_object(self, bucket: str, key: str) -> dict:
         info = self.get_object(bucket, key)
@@ -92,7 +131,10 @@ class S3Service:
 
     def copy_object(self, src_bucket: str, src_key: str, bucket: str, key: str, now: float) -> dict:
         src = self.get_object(src_bucket, src_key)
-        return self.put_object(bucket, key, src["body"], now)
+        # AWS COPY directive default: source metadata travels with the copy
+        return self.put_object(bucket, key, src["body"], now,
+                               content_type=src["content_type"],
+                               metadata=src["metadata"])
 
     def delete_object(self, bucket: str, key: str) -> dict:
         self._bucket(bucket).pop(key, None)
@@ -103,20 +145,62 @@ class S3Service:
         deleted = [k for k in keys if b.pop(k, None) is not None]
         return {"deleted": deleted}
 
-    def list_objects_v2(self, bucket: str, prefix: str = "", continuation: Optional[str] = None, max_keys: int = 1000) -> dict:
+    def list_objects_v2(self, bucket: str, prefix: str = "",
+                        continuation: Optional[str] = None, max_keys: int = 1000,
+                        delimiter: Optional[str] = None,
+                        start_after: Optional[str] = None) -> dict:
+        """AWS semantics incl. the delimiter/common-prefixes edges a real
+        app hits first: keys containing `delimiter` after `prefix` are
+        rolled up into one CommonPrefix entry each; contents and common
+        prefixes share the lexicographic order and the max_keys budget."""
         b = self._bucket(bucket)
         keys = sorted(k for k in b if k.startswith(prefix or ""))
+        # start_after is always a plain key bound (AWS semantics)
+        if start_after:
+            keys = [k for k in keys if k > start_after]
         if continuation:
-            keys = [k for k in keys if k > continuation]
-        page = keys[:max_keys]
-        truncated = len(keys) > len(page)
+            # structured opaque token: "p\0<common-prefix>" means the whole
+            # rolled-up group was consumed (a plain key that merely ends
+            # with the delimiter, e.g. a "folder/" marker object, must NOT
+            # skip its group — that was a silent-data-loss bug)
+            if continuation.startswith("p\0"):
+                cp = continuation[2:]
+                keys = [k for k in keys if k > cp and not k.startswith(cp)]
+            else:
+                token = continuation[2:] if continuation.startswith("k\0") else continuation
+                keys = [k for k in keys if k > token]
+
+        entries: List[Tuple[str, Optional[str]]] = []  # (sort key, rolled prefix|None)
+        seen_prefixes = set()
+        for k in keys:
+            if delimiter:
+                rest = k[len(prefix or ""):]
+                d = rest.find(delimiter)
+                if d >= 0:
+                    cp = (prefix or "") + rest[: d + len(delimiter)]
+                    if cp not in seen_prefixes:
+                        seen_prefixes.add(cp)
+                        entries.append((cp, cp))
+                    continue
+            entries.append((k, None))
+
+        page = entries[:max_keys]
+        truncated = len(entries) > len(page)
+        contents = [
+            {"key": k, "size": len(b[k].body), "e_tag": b[k].etag,
+             "last_modified": b[k].last_modified}
+            for k, cp in page if cp is None
+        ]
+        common = [{"prefix": cp} for _k, cp in page if cp is not None]
+        next_token = None
+        if truncated and page:
+            last_key, last_cp = page[-1]
+            next_token = f"p\0{last_cp}" if last_cp is not None else f"k\0{last_key}"
         return {
-            "contents": [
-                {"key": k, "size": len(b[k].body), "e_tag": b[k].etag, "last_modified": b[k].last_modified}
-                for k in page
-            ],
+            "contents": contents,
+            "common_prefixes": common,
             "is_truncated": truncated,
-            "next_continuation_token": page[-1] if truncated and page else None,
+            "next_continuation_token": next_token,
             "key_count": len(page),
         }
 
@@ -253,7 +337,7 @@ class Client:
 
     def __init__(self, config: Config):
         self._addr = parse_addr(config.endpoint_url.replace("http://", ""))
-        self._ep: Optional[Endpoint] = None
+        self._caller: Optional[StreamCaller] = None
 
     @staticmethod
     def from_conf(config: Config) -> "Client":
@@ -265,12 +349,10 @@ class Client:
         raise AttributeError(name)
 
     async def _call(self, op: str, params: Dict[str, Any]):
-        if self._ep is None:
-            self._ep = await Endpoint.bind(("0.0.0.0", 0))
-        tx, rx = await self._ep.connect1(self._addr)
-        tx.send((op, params))
-        rsp = await rx.recv()
-        tx.close()
+        if self._caller is None:
+            self._caller = StreamCaller()
+            await self._caller.open(self._addr)
+        rsp = await self._caller.call((op, params))
         if rsp is None:
             raise S3Error("ServiceUnavailable", "s3 server unreachable")
         status, payload = rsp

@@ -426,3 +426,220 @@ def test_kafka_timed_out_call_does_not_desync_connection():
         return await c.spawn(go())
 
     assert run(main)
+
+
+# -- round-2 API-surface breadth (VERDICT weak #6) -----------------------------
+
+
+def test_kafka_headers_and_error_codes():
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await kafka.SimBroker().serve("0.0.0.0:9092")
+
+        handle.create_node().name("broker").ip("10.7.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.7.0.2").build()
+
+        async def go():
+            cfg = kafka.ClientConfig({"bootstrap.servers": "10.7.0.1:9092"})
+            admin = await cfg.create_admin()
+            await admin.create_topics([kafka.NewTopic("t", 1)])
+            prod = await cfg.create_future_producer()
+            hdrs = [("trace-id", b"abc123"), ("source", b"svc-a")]
+            await prod.send_and_wait(
+                kafka.FutureRecord("t", payload=b"data", partition=0, headers=hdrs)
+            )
+            consumer = await cfg.create_base_consumer()
+            await consumer.assign("t", 0)
+            msg = await consumer.poll(timeout=1.0)
+            assert msg.headers == hdrs, msg.headers
+
+            # error taxonomy: typed codes, not string matching
+            try:
+                await prod.send_and_wait(kafka.FutureRecord("nope", payload=b"x"))
+                raise AssertionError("unknown topic accepted")
+            except kafka.KafkaError as e:
+                assert e.code == kafka.ErrorCode.UNKNOWN_TOPIC_OR_PART
+            r = await admin.create_topics([kafka.NewTopic("t", 1)])
+            assert r[0][1] is not None  # TopicAlreadyExists, per-topic
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_kafka_message_max_bytes_config():
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await kafka.SimBroker().serve("0.0.0.0:9092")
+
+        handle.create_node().name("broker").ip("10.7.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.7.0.2").build()
+
+        async def go():
+            cfg = kafka.ClientConfig(
+                {"bootstrap.servers": "10.7.0.1:9092", "message.max.bytes": "64"}
+            )
+            await (await cfg.create_admin()).create_topics([kafka.NewTopic("t", 1)])
+            prod = await cfg.create_base_producer()
+            prod.send(kafka.BaseRecord("t", payload=b"x" * 64, partition=0))  # fits
+            try:
+                prod.send(kafka.BaseRecord("t", payload=b"x" * 65, partition=0))
+                raise AssertionError("oversized message accepted")
+            except kafka.KafkaError as e:
+                assert e.code == kafka.ErrorCode.MSG_SIZE_TOO_LARGE
+            await prod.flush()
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_kafka_group_commit_and_resume():
+    # the consumer-group subset: committed offsets persist at the broker,
+    # so a restarted consumer with the same group.id resumes where the
+    # previous one left off (rdkafka Offset::Stored semantics)
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await kafka.SimBroker().serve("0.0.0.0:9092")
+
+        handle.create_node().name("broker").ip("10.7.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.7.0.2").build()
+
+        async def go():
+            cfg = kafka.ClientConfig({"bootstrap.servers": "10.7.0.1:9092"})
+            await (await cfg.create_admin()).create_topics([kafka.NewTopic("t", 1)])
+            prod = await cfg.create_base_producer()
+            for i in range(6):
+                prod.send(kafka.BaseRecord("t", payload=b"m%d" % i, partition=0))
+            await prod.flush()
+
+            gcfg = kafka.ClientConfig(
+                {"bootstrap.servers": "10.7.0.1:9092", "group.id": "g1",
+                 "enable.auto.commit": "false"}
+            )
+            c1 = await gcfg.create_base_consumer()
+            await c1.subscribe(["t"])
+            got1 = [(await c1.poll(1.0)).payload for _ in range(3)]
+            await c1.commit()
+            assert await c1.committed("t", 0) == 3
+
+            # "restarted" consumer, same group: resumes at offset 3
+            c2 = await gcfg.create_base_consumer()
+            await c2.subscribe(["t"])
+            got2 = [(await c2.poll(1.0)).payload for _ in range(3)]
+            assert got1 == [b"m0", b"m1", b"m2"]
+            assert got2 == [b"m3", b"m4", b"m5"]
+
+            # auto-commit mode commits as it goes
+            acfg = kafka.ClientConfig(
+                {"bootstrap.servers": "10.7.0.1:9092", "group.id": "g2"}
+            )
+            a1 = await acfg.create_base_consumer()
+            await a1.subscribe(["t"])
+            await a1.poll(1.0)
+            await a1.poll(1.0)
+            assert await a1.committed("t", 0) == 2
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_s3_delimiter_common_prefixes_and_range():
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await s3.SimServer().serve("0.0.0.0:9000")
+
+        handle.create_node().name("s3").ip("10.8.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.8.0.2").build()
+
+        async def go():
+            cli = s3.Client.from_conf(s3.Config(endpoint_url="http://10.8.0.1:9000"))
+            await cli.create_bucket().bucket("b").send()
+            for k in ["logs/2024/a.log", "logs/2024/b.log", "logs/2025/c.log",
+                      "readme.md", "logs/root.log"]:
+                await cli.put_object().bucket("b").key(k).body(b"x" * 10).send()
+
+            # delimiter rolls up "directories" into common prefixes
+            ls = await cli.list_objects_v2().bucket("b").prefix("logs/").delimiter("/").send()
+            assert [p["prefix"] for p in ls["common_prefixes"]] == ["logs/2024/", "logs/2025/"]
+            assert [o["key"] for o in ls["contents"]] == ["logs/root.log"]
+
+            # continuation across a rolled-up group never re-lists it
+            page1 = await cli.list_objects_v2().bucket("b").prefix("logs/").delimiter("/").max_keys(1).send()
+            assert page1["is_truncated"]
+            page2 = (await cli.list_objects_v2().bucket("b").prefix("logs/").delimiter("/")
+                     .continuation(page1["next_continuation_token"]).send())
+            all_prefixes = [p["prefix"] for p in page1["common_prefixes"] + page2["common_prefixes"]]
+            assert all_prefixes == ["logs/2024/", "logs/2025/"]
+
+            # start_after
+            sa = await cli.list_objects_v2().bucket("b").start_after("logs/2024/a.log").send()
+            assert sa["contents"][0]["key"] == "logs/2024/b.log"
+
+            # ranged get (all three HTTP forms)
+            await cli.put_object().bucket("b").key("blob").body(b"0123456789").send()
+            r1 = await cli.get_object().bucket("b").key("blob").range("bytes=2-5").send()
+            assert r1["body"] == b"2345" and r1["content_range"] == "bytes 2-5/10"
+            r2 = await cli.get_object().bucket("b").key("blob").range("bytes=7-").send()
+            assert r2["body"] == b"789"
+            r3 = await cli.get_object().bucket("b").key("blob").range("bytes=-3").send()
+            assert r3["body"] == b"789"
+            try:
+                await cli.get_object().bucket("b").key("blob").range("bytes=99-").send()
+                raise AssertionError("out-of-range accepted")
+            except s3.S3Error as e:
+                assert e.code == "InvalidRange"
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_s3_content_type_and_user_metadata():
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await s3.SimServer().serve("0.0.0.0:9000")
+
+        handle.create_node().name("s3").ip("10.8.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.8.0.2").build()
+
+        async def go():
+            cli = s3.Client.from_conf(s3.Config(endpoint_url="http://10.8.0.1:9000"))
+            await cli.create_bucket().bucket("b").send()
+            await (cli.put_object().bucket("b").key("doc.json")
+                   .body(b"{}").content_type("application/json")
+                   .metadata({"owner": "svc-a", "ver": "7"}).send())
+            head = await cli.head_object().bucket("b").key("doc.json").send()
+            assert head["content_type"] == "application/json"
+            assert head["metadata"] == {"owner": "svc-a", "ver": "7"}
+            # copies carry metadata (AWS COPY directive default)
+            await (cli.copy_object().src_bucket("b").src_key("doc.json")
+                   .bucket("b").key("doc2.json").send())
+            head2 = await cli.head_object().bucket("b").key("doc2.json").send()
+            assert head2["content_type"] == "application/json"
+            assert head2["metadata"]["owner"] == "svc-a"
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
