@@ -134,11 +134,35 @@ class Machine:
         """Initial node-state pytree (every leaf leading dim NUM_NODES)."""
         raise NotImplementedError
 
-    def init_node(self, nodes: Any, i, rng_key) -> Any:
-        """Reset node i to its initial state (used on restart faults).
-        Default: re-derive from init() and copy row i."""
+    def _wipe_node_if(self, nodes: Any, i, cond, rng_key) -> Any:
+        """Non-virtual building block: copy row i from a fresh init()
+        under `cond` (never dispatches to overrides — safe to call from
+        any subclass hook without recursion)."""
         fresh = self.init(rng_key)
-        return jax.tree.map(lambda cur, f: set_at(cur, i, f[i]), nodes, fresh)
+
+        def leaf(cur, f):
+            mask = (jnp.arange(cur.shape[0]) == i) & cond
+            while mask.ndim < cur.ndim:
+                mask = mask[..., None]
+            return jnp.where(mask, f, cur)
+
+        return jax.tree.map(leaf, nodes, fresh)
+
+    def init_node(self, nodes: Any, i, rng_key) -> Any:
+        """Reset node i to its initial state (legacy restart hook).
+        Default: re-derive from init() and copy row i."""
+        return self._wipe_node_if(nodes, i, jnp.bool_(True), rng_key)
+
+    def restart_if(self, nodes: Any, i, cond, rng_key) -> Any:
+        """Conditionally reset node i — the engine's restart-fault hook
+        (`cond` is a traced bool). The default honors a subclass's
+        `init_node` override (the older restart hook), so machines with
+        durable/volatile splits written against that API keep their
+        semantics; override `restart_if` directly and fold `cond` into
+        your own row masks to skip the full-tree select (it cost ~30% of
+        raft's eager step time)."""
+        fresh = self.init_node(nodes, i, rng_key)
+        return jax.tree.map(lambda c, f: jnp.where(cond, f, c), nodes, fresh)
 
     def on_timer(self, nodes: Any, node, timer_id, now_us, rand_u32) -> Tuple[Any, Outbox]:
         raise NotImplementedError

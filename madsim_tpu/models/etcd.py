@@ -130,8 +130,11 @@ class EtcdMachine(Machine):
         revisions, leases and the election through restart —
         service.rs state lives behind raft); a client loses its session
         state. Epochs always survive (timer-chain bookkeeping)."""
+        return self.restart_if(nodes, i, jnp.bool_(True), rng_key)
+
+    def restart_if(self, nodes: EtcdState, i, cond, rng_key) -> EtcdState:
         n = self.NUM_NODES
-        row = jnp.arange(n) == i
+        row = (jnp.arange(n) == i) & cond
         is_client = i != SERVER
         reset_i32 = lambda arr: jnp.where(row & is_client, 0, arr)  # noqa: E731
         reset_b = lambda arr: jnp.where(row & is_client, False, arr)  # noqa: E731

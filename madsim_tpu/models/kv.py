@@ -76,10 +76,12 @@ class KvMachine(Machine):
 
     def init_node(self, nodes: KvState, i, rng_key) -> KvState:
         """Restart: the server's store is durable; client sessions reset."""
+        return self.restart_if(nodes, i, jnp.bool_(True), rng_key)
+
+    def restart_if(self, nodes: KvState, i, cond, rng_key) -> KvState:
         is_server = i == SERVER
-        reset = lambda arr: jnp.where(  # noqa: E731
-            (jnp.arange(self.NUM_NODES) == i) & ~is_server, 0, arr
-        )
+        mask = (jnp.arange(self.NUM_NODES) == i) & ~is_server & cond
+        reset = lambda arr: jnp.where(mask, 0, arr)  # noqa: E731
         return nodes.replace(
             acked_version=reset(nodes.acked_version),
             next_val=reset(nodes.next_val),

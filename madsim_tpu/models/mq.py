@@ -89,9 +89,11 @@ class MqMachine(Machine):
         """Restart: broker durable (log + dedup cursors persist, like
         Kafka's on-disk partitions); producers/consumer reset volatile
         session state."""
+        return self.restart_if(nodes, i, jnp.bool_(True), rng_key)
+
+    def restart_if(self, nodes: MqState, i, cond, rng_key) -> MqState:
         n = self.NUM_NODES
-        not_broker = i != BROKER
-        mask = (jnp.arange(n) == i) & not_broker
+        mask = (jnp.arange(n) == i) & (i != BROKER) & cond
         return nodes.replace(
             next_seq=jnp.where(mask, 0, nodes.next_seq),
             inflight=jnp.where(mask, False, nodes.inflight),

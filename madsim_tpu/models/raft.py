@@ -100,14 +100,21 @@ class RaftMachine(Machine):
     def init_node(self, nodes: RaftState, i, rng_key) -> RaftState:
         """Restart: persistent state survives, volatile resets
         (Raft §5.1 stable storage semantics)."""
+        return self.restart_if(nodes, i, jnp.bool_(True), rng_key)
+
+    def restart_if(self, nodes: RaftState, i, cond, rng_key) -> RaftState:
+        """Masked restart: cond folds into the row mask, so the engine's
+        per-step fault branch costs row writes, not a full-tree select."""
         n = self.NUM_NODES
+        row = (jnp.arange(n) == i) & cond
+        set_row = lambda arr, v: jnp.where(row, v, arr)  # noqa: E731
         return nodes.replace(
-            role=set_at(nodes.role, i, FOLLOWER),
-            votes=set_at(nodes.votes, i, 0),
-            elec_deadline=set_at(nodes.elec_deadline, i, 0),
-            commit=set_at(nodes.commit, i, 0),
-            next_idx=set_at(nodes.next_idx, i, jnp.ones((n,), jnp.int32)),
-            match_idx=set_at(nodes.match_idx, i, jnp.zeros((n,), jnp.int32)),
+            role=set_row(nodes.role, FOLLOWER),
+            votes=set_row(nodes.votes, 0),
+            elec_deadline=set_row(nodes.elec_deadline, 0),
+            commit=set_row(nodes.commit, 0),
+            next_idx=jnp.where(row[:, None], 1, nodes.next_idx),
+            match_idx=jnp.where(row[:, None], 0, nodes.match_idx),
         )
 
     # -- helpers -------------------------------------------------------------

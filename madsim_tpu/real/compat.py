@@ -78,6 +78,11 @@ class time:
     def now_ns() -> int:
         return _pytime.time_ns()
 
+    @staticmethod
+    def monotonic() -> float:
+        # for elapsed-time measurement (deadlines): immune to NTP steps
+        return _pytime.monotonic()
+
 
 class _RealRng:
     """GlobalRng draw surface over the stdlib RNG (production mode —

@@ -27,7 +27,14 @@ Endpoint = _dual_net.Endpoint
 
 
 class StreamCaller:
-    """`await call(req) -> response payload | None` (None = unavailable)."""
+    """`await call(req) -> response payload | None` (None = unavailable).
+
+    `idempotent=True` marks requests safe to transparently re-send after
+    a response was lost mid-flight (reads). Mutations are only retried
+    when the failure happened at SEND time on a stale cached stream —
+    provably before the server saw anything — never after an ambiguous
+    response loss (a blind produce/create retry would silently duplicate
+    the operation)."""
 
     def __init__(self) -> None:
         self._ep = None
@@ -43,7 +50,7 @@ class StreamCaller:
 
             self._lock = asyncio.Lock()
 
-    async def call(self, req: tuple) -> Optional[Any]:
+    async def call(self, req: tuple, idempotent: bool = False) -> Optional[Any]:
         if IS_SIM:
             tx, rx = await self._ep.connect1(self._addr)
             try:
@@ -60,12 +67,21 @@ class StreamCaller:
                     tx, rx = self._stream
                     try:
                         tx.send(req)
+                    except ConnectionReset:
+                        # stale cached stream detected before anything left
+                        # this process: always safe to reopen + retry
+                        self._drop_stream()
+                        continue
+                    try:
                         rsp = await rx.recv()
                     except ConnectionReset:
                         rsp = None
                     if rsp is None:
+                        # request may or may not have been applied
                         self._drop_stream()
-                        continue  # reopen once (attempt 1), else fall out
+                        if idempotent and attempt == 0:
+                            continue
+                        return None
                     return rsp
                 return None
             except BaseException:

@@ -319,8 +319,10 @@ class _Conn:
     async def open(self, addr) -> None:
         await self._caller.open(addr)
 
+    _IDEMPOTENT = {"fetch", "metadata", "watermarks", "offsets_for_time", "committed"}
+
     async def call(self, req: tuple):
-        rsp = await self._caller.call(req)
+        rsp = await self._caller.call(req, idempotent=req[0] in self._IDEMPOTENT)
         if rsp is None:
             raise KafkaError("broker unavailable", ErrorCode.TIMED_OUT)
         status, payload = rsp
@@ -540,7 +542,7 @@ class BaseConsumer:
         With group.id + enable.auto.commit, the new position is committed
         after each delivered message (interval-batching simplified to
         per-message; same observable at-least-once semantics)."""
-        deadline = sim_time.now() + timeout if timeout is not None else None
+        deadline = sim_time.monotonic() + timeout if timeout is not None else None
         while True:
             for (topic, part), pos in sorted(self._positions.items()):
                 msgs = await self._conn.call(("fetch", topic, part, pos, 1))
@@ -551,7 +553,7 @@ class BaseConsumer:
                             ("commit_offsets", self._group, {(topic, part): msgs[0].offset + 1})
                         )
                     return msgs[0]
-            if deadline is not None and sim_time.now() >= deadline:
+            if deadline is not None and sim_time.monotonic() >= deadline:
                 return None
             await sim_time.sleep(self._poll_interval)
 

@@ -352,7 +352,9 @@ class Client:
         if self._caller is None:
             self._caller = StreamCaller()
             await self._caller.open(self._addr)
-        rsp = await self._caller.call((op, params))
+        idem = op in ("get_object", "head_object", "list_objects_v2",
+                      "get_bucket_lifecycle_configuration")
+        rsp = await self._caller.call((op, params), idempotent=idem)
         if rsp is None:
             raise S3Error("ServiceUnavailable", "s3 server unreachable")
         status, payload = rsp

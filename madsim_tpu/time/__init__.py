@@ -41,6 +41,7 @@ __all__ = [
     "advance",
     "now",
     "now_ns",
+    "monotonic",
     "to_ns",
 ]
 
@@ -406,6 +407,13 @@ def advance(duration: Union[int, float]) -> None:
 
 def now() -> float:
     """Virtual seconds since simulation start."""
+    return _context.current_time().elapsed()
+
+
+def monotonic() -> float:
+    """Monotonic seconds for elapsed-time measurement. In the simulator
+    the virtual clock is monotonic by construction; the real-mode twin
+    maps to time.monotonic() (immune to NTP steps)."""
     return _context.current_time().elapsed()
 
 

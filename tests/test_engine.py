@@ -168,15 +168,48 @@ def test_kv_machine_durable_store_holds(raft_engine):
     assert int(jnp.min(res.summary["server_version"])) > 0
 
 
+def test_base_restart_if_honors_legacy_init_node_override():
+    # out-of-tree machines written against the older hook (init_node only)
+    # must keep their durable-state semantics under the engine's
+    # restart_if path
+    from flax import struct
+
+    from madsim_tpu.engine.machine import Machine
+
+    @struct.dataclass
+    class S:
+        durable: jax.Array
+        volatile: jax.Array
+
+    class LegacyMachine(Machine):
+        NUM_NODES = 3
+
+        def init(self, rng_key):
+            z = jnp.zeros((3,), jnp.int32)
+            return S(durable=z, volatile=z)
+
+        def init_node(self, nodes, i, rng_key):  # legacy restart hook
+            mask = jnp.arange(3) == i
+            return nodes.replace(volatile=jnp.where(mask, 0, nodes.volatile))
+
+    m = LegacyMachine()
+    nodes = S(durable=jnp.array([5, 6, 7]), volatile=jnp.array([1, 2, 3]))
+    out = m.restart_if(nodes, jnp.int32(1), jnp.bool_(True), jax.random.PRNGKey(0))
+    assert out.durable.tolist() == [5, 6, 7]  # durable survives
+    assert out.volatile.tolist() == [1, 0, 3]  # only row 1 reset
+    out2 = m.restart_if(nodes, jnp.int32(1), jnp.bool_(False), jax.random.PRNGKey(0))
+    assert out2.volatile.tolist() == [1, 2, 3]  # cond gates everything
+
+
 def test_kv_machine_catches_durability_bug():
     """A KV server that loses state on restart must produce stale reads
     on some seeds (the etcd-class bug the workload exists to catch)."""
     from madsim_tpu.models import kv as kvmod
 
     class DurabilityBugKv(kvmod.KvMachine):
-        def init_node(self, nodes, i, rng_key):
+        def restart_if(self, nodes, i, cond, rng_key):
             # BUG: resets everything, including the server's store
-            return super(kvmod.KvMachine, self).init_node(nodes, i, rng_key)
+            return self._wipe_node_if(nodes, i, cond, rng_key)
 
     cfg = EngineConfig(
         horizon_us=3_000_000,

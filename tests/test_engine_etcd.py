@@ -78,10 +78,10 @@ class VolatileEtcd(EtcdMachine):
     leases) — the durability bug class the reference's dump/load +
     raft-backed store exists to prevent."""
 
-    def init_node(self, nodes, i, rng_key):
-        nodes = super().init_node(nodes, i, rng_key)
+    def restart_if(self, nodes, i, cond, rng_key):
+        nodes = super().restart_if(nodes, i, cond, rng_key)
         n = self.NUM_NODES
-        wipe_all = i == SERVER
+        wipe_all = (i == SERVER) & cond
         z = jnp.zeros((n,), jnp.int32)
         pick = lambda wiped, cur: jnp.where(wipe_all, wiped, cur)  # noqa: E731
         return nodes.replace(
