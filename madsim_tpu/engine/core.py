@@ -321,7 +321,7 @@ class Engine:
             # select here (XLA CSEs it inside the fused loop, but eager
             # step_batch paid ~30% for it, and masked writes are strictly
             # less work for any backend)
-            nodes = m.restart_if(s.nodes, a, op == F_RESTART, k_restart)
+            nodes = m.restart_node_if(s.nodes, a, op == F_RESTART, k_restart)
             boot_node = jnp.where(op == F_RESTART, a, jnp.int32(-1))
             return nodes, m.empty_outbox(), clogged, killed, boot_node
 

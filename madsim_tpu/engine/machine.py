@@ -93,9 +93,10 @@ def set_timer_if(outbox: Outbox, slot: int, cond, delay_us, timer_id) -> Outbox:
     )
 
 
-def set_at(arr: jax.Array, i, value) -> jax.Array:
-    """`arr.at[i].set(value)` for traced i, as a masked select."""
-    mask = jnp.arange(arr.shape[0]) == i
+def set_at(arr: jax.Array, i, value, cond=True) -> jax.Array:
+    """`arr.at[i].set(value)` for traced i, as a masked select; `cond`
+    (traced bool) gates the whole write."""
+    mask = (jnp.arange(arr.shape[0]) == i) & cond
     while mask.ndim < arr.ndim:
         mask = mask[..., None]
     return jnp.where(mask, value, arr)
@@ -139,14 +140,7 @@ class Machine:
         under `cond` (never dispatches to overrides — safe to call from
         any subclass hook without recursion)."""
         fresh = self.init(rng_key)
-
-        def leaf(cur, f):
-            mask = (jnp.arange(cur.shape[0]) == i) & cond
-            while mask.ndim < cur.ndim:
-                mask = mask[..., None]
-            return jnp.where(mask, f, cur)
-
-        return jax.tree.map(leaf, nodes, fresh)
+        return jax.tree.map(lambda cur, f: set_at(cur, i, f, cond), nodes, fresh)
 
     def init_node(self, nodes: Any, i, rng_key) -> Any:
         """Reset node i to its initial state (legacy restart hook).
@@ -163,6 +157,31 @@ class Machine:
         raft's eager step time)."""
         fresh = self.init_node(nodes, i, rng_key)
         return jax.tree.map(lambda c, f: jnp.where(cond, f, c), nodes, fresh)
+
+    def restart_node_if(self, nodes: Any, i, cond, rng_key) -> Any:
+        """Engine-facing restart dispatch — do NOT override. Picks the
+        restart hook by MRO position so both authoring styles work:
+
+          * a subclass overriding `restart_if` (the fast path) wins when
+            it is at least as derived as any `init_node` override;
+          * a subclass overriding only the legacy `init_node` hook gets
+            the generic bridge (fresh = init_node; tree-select on cond)
+            even when a base model ships a fast-path `restart_if` —
+            otherwise the override would be silently ignored, and a
+            guard inside each model's restart_if can mutually recurse
+            with init_node shims that delegate to restart_if.
+        """
+        mro = type(self).__mro__
+
+        def hook_owner(name):
+            return next(c for c in mro if name in c.__dict__)
+
+        init_owner = hook_owner("init_node")
+        rif_owner = hook_owner("restart_if")
+        if init_owner is not Machine and mro.index(init_owner) < mro.index(rif_owner):
+            fresh = self.init_node(nodes, i, rng_key)
+            return jax.tree.map(lambda c, f: jnp.where(cond, f, c), nodes, fresh)
+        return self.restart_if(nodes, i, cond, rng_key)
 
     def on_timer(self, nodes: Any, node, timer_id, now_us, rand_u32) -> Tuple[Any, Outbox]:
         raise NotImplementedError

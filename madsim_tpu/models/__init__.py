@@ -11,8 +11,10 @@ invariant (the rdkafka-class workload).
 `etcd` — leased-KV leader election (grant/campaign/keepalive over an
 MVCC server), lease-safety invariant (the madsim-etcd-client service-
 class workload, batched).
+`twopc` — two-phase commit with durable write-ahead logs, transaction-
+atomicity invariant (the atomic-commitment workload class).
 """
 
-from . import echo, etcd, kv, mq, raft
+from . import echo, etcd, kv, mq, raft, twopc
 
-__all__ = ["echo", "etcd", "kv", "mq", "raft"]
+__all__ = ["echo", "etcd", "kv", "mq", "raft", "twopc"]

@@ -1,0 +1,267 @@
+"""Two-phase commit machine — the transaction-atomicity engine workload.
+
+Node 0 is the coordinator; nodes 1..N-1 are participants (resource
+managers). The coordinator drives MAX_TXN transactions sequentially:
+PREPARE to all, collect votes (any NO => early abort), log the decision
+durably, deliver COMMIT/ABORT until every participant acks, advance.
+Participants vote YES/NO (NO with probability 1/8 from the event rand
+word), log their vote durably, and unilaterally record ABORT the moment
+they vote NO (presumed-abort, the standard optimisation). All logs
+(votes, outcomes, decision, txn counter) survive restart faults; vote
+collection and ack tracking are volatile and are rebuilt by retry ticks.
+
+Checked invariant (code 120, ATOMICITY): for every transaction, no two
+participants record different outcomes. This is the safety property 2PC
+exists to provide; it holds under message loss, partitions and crash/
+restart of any node *because* the decision is logged before delivery and
+a NO vote forces a global abort. It breaks immediately for the classic
+"eager" coordinator that presumes missing votes are YES (the
+`EagerCommitTwoPc` variant in tests): a NO-voting participant has
+already aborted unilaterally while the others are told to commit.
+
+Reference workload class: madsim's multi-node integration tests of
+commit protocols under chaos (tonic-example/tests/test.rs crash loops);
+the reference has no 2PC model — this extends the engine's model family
+beyond replication (raft) to atomic commitment.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..engine.machine import (
+    Machine,
+    Outbox,
+    make_payload,
+    send_if,
+    set_timer_if,
+)
+
+COORD = 0
+
+# message types
+M_PREP, M_VOTE, M_DEC, M_ACK = 1, 2, 3, 4
+
+# outcomes / decisions
+COMMIT, ABORT = 1, 2
+
+# votes
+V_YES, V_NO = 1, 2
+
+# timers
+T_BOOT, T_TICK = 0, 1
+
+ATOMICITY = 120
+
+TICK_US = 30_000
+
+
+@struct.dataclass
+class TwoPcState:
+    # durable everywhere (write-ahead logs)
+    cur_txn: jax.Array  # int32[N] coordinator's txn counter (row COORD)
+    decision: jax.Array  # int32[N, MAX_TXN] coordinator decision log (row COORD)
+    voted: jax.Array  # int32[N, MAX_TXN] participant vote log (0/V_YES/V_NO)
+    outcome: jax.Array  # int32[N, MAX_TXN] participant outcome log (0/COMMIT/ABORT)
+    # volatile (rebuilt by retries after restart)
+    votes_recv: jax.Array  # int32[N] bitmask of participants whose vote arrived
+    votes_yes: jax.Array  # int32[N] bitmask of YES votes among those
+    acks: jax.Array  # int32[N] bitmask of participants that acked the decision
+
+
+class TwoPcMachine(Machine):
+    PAYLOAD_WIDTH = 4
+    MAX_TIMERS = 1
+
+    def __init__(self, num_nodes: int = 4, max_txn: int = 6):
+        self.NUM_NODES = num_nodes
+        self.MAX_TXN = max_txn
+        self.MAX_MSGS = num_nodes - 1  # one static slot per peer
+        # participant bitmask: bits 1..N-1
+        self._full_mask = ((1 << num_nodes) - 1) & ~1
+
+    def init(self, rng_key) -> TwoPcState:
+        n, t = self.NUM_NODES, self.MAX_TXN
+        z1 = jnp.zeros((n,), jnp.int32)
+        z2 = jnp.zeros((n, t), jnp.int32)
+        return TwoPcState(
+            cur_txn=z1, decision=z2, voted=z2, outcome=z2,
+            votes_recv=z1, votes_yes=z1, acks=z1,
+        )
+
+    def init_node(self, nodes: TwoPcState, i, rng_key) -> TwoPcState:
+        """Legacy restart hook: same durable-WAL semantics as restart_if
+        (every shipped model keeps this shim so subclasses built on the
+        older hook inherit the right durability split)."""
+        return self.restart_if(nodes, i, jnp.bool_(True), rng_key)
+
+    def restart_if(self, nodes: TwoPcState, i, cond, rng_key) -> TwoPcState:
+        """Logs are durable; only the in-flight collection state resets."""
+        mask = (jnp.arange(self.NUM_NODES) == i) & cond
+        reset = lambda arr: jnp.where(mask, 0, arr)  # noqa: E731
+        return nodes.replace(
+            votes_recv=reset(nodes.votes_recv),
+            votes_yes=reset(nodes.votes_yes),
+            acks=reset(nodes.acks),
+        )
+
+    # -- decision policy (overridable; the tests break it on purpose) --------
+
+    def _all_votes_in(self, votes_recv) -> jax.Array:
+        return votes_recv == self._full_mask
+
+    # -- helpers --------------------------------------------------------------
+
+    def _col(self, t) -> jax.Array:
+        return jnp.arange(self.MAX_TXN) == t
+
+    def _set_cell(self, arr, node, t, value, cond) -> jax.Array:
+        """arr[node, t] = value where cond, as a masked select."""
+        m = ((jnp.arange(arr.shape[0]) == node)[:, None]
+             & self._col(t)[None, :] & cond)
+        return jnp.where(m, jnp.int32(value), arr)
+
+    def _pay(self, *vals) -> jax.Array:
+        return make_payload(self.PAYLOAD_WIDTH, *vals)
+
+    # -- timers ---------------------------------------------------------------
+
+    def on_timer(self, nodes: TwoPcState, node, timer_id, now_us, rand_u32
+                 ) -> Tuple[TwoPcState, Outbox]:
+        outbox = self.empty_outbox()
+        is_coord = node == COORD
+
+        # boot/restart: only the coordinator drives; participants are reactive
+        outbox = set_timer_if(
+            outbox, 0, (timer_id == T_BOOT) & is_coord, TICK_US, T_TICK)
+
+        is_tick = (timer_id == T_TICK) & is_coord
+        t = jnp.minimum(nodes.cur_txn[COORD], self.MAX_TXN - 1)
+        active = nodes.cur_txn[COORD] < self.MAX_TXN
+        dec = nodes.decision[COORD, t]
+        phase_vote = is_tick & active & (dec == 0)
+        phase_dec = is_tick & active & (dec != 0)
+
+        prep = self._pay(M_PREP, t)
+        decmsg = self._pay(M_DEC, t, dec)
+        for p in range(1, self.NUM_NODES):
+            bit = jnp.int32(1 << p)
+            need_vote = phase_vote & ((nodes.votes_recv[COORD] & bit) == 0)
+            need_ack = phase_dec & ((nodes.acks[COORD] & bit) == 0)
+            outbox = send_if(outbox, p - 1, need_vote, p, prep)
+            outbox = send_if(outbox, p - 1, need_ack, p, decmsg)
+
+        outbox = set_timer_if(outbox, 0, is_tick & active, TICK_US, T_TICK)
+        return nodes, outbox
+
+    # -- messages -------------------------------------------------------------
+
+    def on_message(self, nodes: TwoPcState, node, src, payload, now_us, rand_u32
+                   ) -> Tuple[TwoPcState, Outbox]:
+        outbox = self.empty_outbox()
+        mtype, mt = payload[0], payload[1]
+
+        # ---- participant side ----
+        is_part = node != COORD
+
+        # PREPARE: vote once (durable), re-reply idempotently on duplicates
+        is_prep = is_part & (mtype == M_PREP)
+        prior = nodes.voted[node, mt]
+        roll_no = (rand_u32[0] % jnp.uint32(8)) == 0
+        fresh_vote = jnp.where(roll_no, V_NO, V_YES).astype(jnp.int32)
+        vote = jnp.where(prior == 0, fresh_vote, prior)
+        nodes = nodes.replace(
+            voted=self._set_cell(nodes.voted, node, mt, vote, is_prep),
+            # unilateral abort: a NO voter knows the txn cannot commit
+            outcome=self._set_cell(
+                nodes.outcome, node, mt, ABORT,
+                is_prep & (vote == V_NO) & (nodes.outcome[node, mt] == 0)),
+        )
+        outbox = send_if(outbox, 0, is_prep, COORD,
+                         self._pay(M_VOTE, mt, vote))
+
+        # DECISION: record once (first write wins), always ack
+        is_dec = is_part & (mtype == M_DEC)
+        nodes = nodes.replace(
+            outcome=self._set_cell(
+                nodes.outcome, node, mt, payload[2],
+                is_dec & (nodes.outcome[node, mt] == 0)),
+        )
+        outbox = send_if(outbox, 0, is_dec, COORD, self._pay(M_ACK, mt))
+
+        # ---- coordinator side ----
+        is_coord = node == COORD
+        cur = nodes.cur_txn[COORD]
+        t = jnp.minimum(cur, self.MAX_TXN - 1)
+        current = (mt == cur) & (cur < self.MAX_TXN)
+        bit = (jnp.int32(1) << src).astype(jnp.int32)
+
+        # VOTE: collect; all-in or any-NO => decide + log + deliver now
+        undecided = nodes.decision[COORD, t] == 0
+        is_vote = is_coord & (mtype == M_VOTE) & current & undecided
+        votes_recv = jnp.where(is_vote, nodes.votes_recv[COORD] | bit,
+                               nodes.votes_recv[COORD])
+        yes_bit = jnp.where(payload[2] == V_YES, bit, 0)
+        votes_yes = jnp.where(is_vote, nodes.votes_yes[COORD] | yes_bit,
+                              nodes.votes_yes[COORD])
+        any_no = (votes_recv & ~votes_yes & jnp.int32(self._full_mask)) != 0
+        decide = is_vote & (self._all_votes_in(votes_recv) | any_no)
+        d = jnp.where(any_no, ABORT, COMMIT).astype(jnp.int32)
+        row = jnp.arange(self.NUM_NODES) == COORD
+        nodes = nodes.replace(
+            votes_recv=jnp.where(row & is_vote, votes_recv, nodes.votes_recv),
+            votes_yes=jnp.where(row & is_vote, votes_yes, nodes.votes_yes),
+            decision=self._set_cell(nodes.decision, COORD, t, d, decide),
+        )
+
+        # ACK: collect; all acked => advance to the next transaction
+        decided = nodes.decision[COORD, t] != 0
+        is_ack = is_coord & (mtype == M_ACK) & current & decided
+        acks = jnp.where(is_ack, nodes.acks[COORD] | bit, nodes.acks[COORD])
+        advance = is_ack & (acks == self._full_mask)
+        nodes = nodes.replace(
+            acks=jnp.where(row & is_ack & ~advance, acks, jnp.where(
+                row & advance, 0, nodes.acks)),
+            cur_txn=jnp.where(row & advance, cur + 1, nodes.cur_txn),
+            votes_recv=jnp.where(row & advance, 0, nodes.votes_recv),
+            votes_yes=jnp.where(row & advance, 0, nodes.votes_yes),
+        )
+
+        # fast path: on decide, deliver the decision without waiting a tick;
+        # on advance, prepare the next txn immediately (conditions disjoint)
+        dec_now = self._pay(M_DEC, t, nodes.decision[COORD, t])
+        prep_next = self._pay(M_PREP, jnp.minimum(cur + 1, self.MAX_TXN - 1))
+        next_active = advance & (cur + 1 < self.MAX_TXN)
+        for p in range(1, self.NUM_NODES):
+            pb = jnp.int32(1 << p)
+            deliver = decide & ((nodes.acks[COORD] & pb) == 0)
+            outbox = send_if(outbox, p - 1, deliver, p, dec_now)
+            outbox = send_if(outbox, p - 1, next_active, p, prep_next)
+        return nodes, outbox
+
+    # -- invariants / results -------------------------------------------------
+
+    def invariant(self, nodes: TwoPcState, now_us):
+        part = nodes.outcome[1:, :]  # participants only
+        committed = jnp.any(part == COMMIT, axis=0)
+        aborted = jnp.any(part == ABORT, axis=0)
+        mixed = jnp.any(committed & aborted)
+        ok = ~mixed
+        return ok, jnp.where(ok, 0, ATOMICITY).astype(jnp.int32)
+
+    def is_done(self, nodes: TwoPcState, now_us):
+        return nodes.cur_txn[COORD] >= self.MAX_TXN
+
+    def summary(self, nodes: TwoPcState):
+        part = nodes.outcome[1:, :]
+        all_commit = jnp.all(part == COMMIT, axis=0)
+        all_abort = jnp.all(part == ABORT, axis=0)
+        return {
+            "txns": nodes.cur_txn[COORD],
+            "committed": jnp.sum(all_commit.astype(jnp.int32)),
+            "aborted": jnp.sum(all_abort.astype(jnp.int32)),
+        }

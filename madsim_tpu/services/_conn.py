@@ -61,34 +61,66 @@ class StreamCaller:
 
         async with self._lock:
             try:
-                for attempt in (0, 1):
+                # separate budgets: a send-time reconnect is provably safe
+                # (nothing reached the server) and must not consume the
+                # single ambiguous-loss retry an idempotent request gets
+                send_retries = 1
+                loss_retries = 1 if idempotent else 0
+                while True:
                     if self._stream is None:
-                        self._stream = await self._ep.connect1(self._addr)
+                        try:
+                            self._stream = await self._ep.connect1(self._addr)
+                        except (ConnectionReset, OSError):
+                            # server down/refusing: "unavailable", not a
+                            # raw exception out of the drop-in client API
+                            if send_retries > 0:
+                                send_retries -= 1
+                                continue
+                            return None
                     tx, rx = self._stream
                     try:
                         tx.send(req)
-                    except ConnectionReset:
+                    except (ConnectionReset, OSError):
                         # stale cached stream detected before anything left
                         # this process: always safe to reopen + retry
                         self._drop_stream()
-                        continue
+                        if send_retries > 0:
+                            send_retries -= 1
+                            continue
+                        return None
                     try:
                         rsp = await rx.recv()
-                    except ConnectionReset:
+                    except (ConnectionReset, OSError):
+                        # OSError: socket failures the real transport does
+                        # not map (ETIMEDOUT, broken pipe, ...) — same
+                        # "unavailable" outcome, never a raw exception out
+                        # of the drop-in client API
                         rsp = None
                     if rsp is None:
                         # request may or may not have been applied
                         self._drop_stream()
-                        if idempotent and attempt == 0:
+                        if loss_retries > 0:
+                            loss_retries -= 1
                             continue
                         return None
                     return rsp
-                return None
             except BaseException:
                 # cancellation (call timeout) or unexpected error mid-call:
                 # the stream may carry an unconsumed response — drop it
                 self._drop_stream()
                 raise
+
+    async def open_stream(self):
+        """Open a DEDICATED (tx, rx) channel to the server, outside the
+        shared unary stream — for long-lived subscriptions (etcd watch/
+        observe). Connect failures surface as ConnectionReset so callers
+        can map them to their drop-in typed error."""
+        try:
+            return await self._ep.connect1(self._addr)
+        except ConnectionReset:
+            raise
+        except OSError as e:
+            raise ConnectionReset(str(e)) from e
 
     def _drop_stream(self) -> None:
         if self._stream is not None:

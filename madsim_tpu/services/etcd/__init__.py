@@ -22,6 +22,7 @@ from ...dual import task as _dual_task
 
 Endpoint = _dual_net.Endpoint
 spawn = _dual_task.spawn
+from .._conn import StreamCaller
 from .service import EtcdError, EtcdService, Event, KeyValue, MAX_REQUEST_BYTES
 
 __all__ = [
@@ -139,31 +140,36 @@ class SimServer:
             spawn(self._handle(tx, rx), name="etcd-conn")
 
     async def _handle(self, tx, rx) -> None:
+        """One connection serves one long-lived subscription (watch/
+        observe) or a loop of unary requests — the same dual shape the
+        kafka/s3 servers speak, so real-mode clients can keep one
+        persistent stream (StreamCaller) instead of a socket per call."""
         svc = self.service
         rng = rand.thread_rng()
         try:
-            req = await rx.recv()
-            if req is None:
-                return
-            if self.timeout_rate > 0 and rng.gen_bool(self.timeout_rate):
-                tx.send(("err", "etcdserver: request timed out"))
-                return
-            kind = req[0]
-            if kind == "watch":
-                await self._watch(tx, rx, req[1], req[2])
-                return
-            if kind == "observe":
-                await self._observe(tx, rx, req[1])
-                return
-            try:
-                result = self._apply(svc, req)
-                tx.send(("ok", result))
-            except EtcdError as e:
-                tx.send(("err", str(e)))
+            while True:
+                req = await rx.recv()
+                if req is None:
+                    return
+                if self.timeout_rate > 0 and rng.gen_bool(self.timeout_rate):
+                    tx.send(("err", "etcdserver: request timed out"))
+                    continue
+                kind = req[0]
+                if kind == "watch":
+                    await self._watch(tx, rx, req[1], req[2])
+                    return
+                if kind == "observe":
+                    await self._observe(tx, rx, req[1])
+                    return
+                try:
+                    result = self._apply(svc, req)
+                    tx.send(("ok", result))
+                except EtcdError as e:
+                    tx.send(("err", str(e)))
         except ConnectionReset:
             pass
         finally:
-            tx.close()  # real mode: one fd per request must not linger
+            tx.close()  # real mode: a finished connection must not linger
 
     def _apply(self, svc: EtcdService, req: tuple):
         kind = req[0]
@@ -288,21 +294,24 @@ class Client:
 
     def __init__(self, addr):
         self._addr = addr
-        self._ep: Optional[Endpoint] = None
+        self._caller = StreamCaller()
 
     @staticmethod
     async def connect(endpoints: Union[str, Sequence[str]], timeout: Optional[float] = None) -> "Client":
         if isinstance(endpoints, str):
             endpoints = [endpoints]
         client = Client(parse_addr(endpoints[0]))
-        client._ep = await Endpoint.bind(("0.0.0.0", 0))
+        await client._caller.open(client._addr)
         return client
 
+    # reads are safe to transparently re-send after an ambiguous response
+    # loss in real mode; mutations (put/txn/delete/lease_grant/campaign)
+    # are not — a blind retry could double-apply against MVCC revisions
+    _IDEMPOTENT = {"get", "leader", "status", "dump",
+                   "lease_time_to_live", "lease_list"}
+
     async def _call(self, req: tuple):
-        tx, rx = await self._ep.connect1(self._addr)
-        tx.send(req)
-        rsp = await rx.recv()
-        tx.close()
+        rsp = await self._caller.call(req, idempotent=req[0] in self._IDEMPOTENT)
         if rsp is None:
             raise EtcdError("etcd server unavailable")
         status, payload = rsp
@@ -374,22 +383,32 @@ class Client:
         return await self._call(("resign", leader["leader"]))
 
     async def observe(self, name: Key) -> Observer:
-        tx, rx = await self._ep.connect1(self._addr)
+        tx, rx = await self._open_sub()
         tx.send(("observe", _b(name)))
         head = await rx.recv()
         if head is None or head[0] != "ok":
+            tx.close()  # both ends release the failed subscription
             raise EtcdError(f"observe failed: {head}")
         return Observer(tx, rx)
+
+    async def _open_sub(self):
+        """Dedicated channel for a subscription; server-down surfaces as
+        the typed error, not a raw OSError."""
+        try:
+            return await self._caller.open_stream()
+        except ConnectionReset as e:
+            raise EtcdError(f"etcd server unavailable: {e}") from e
 
     # -- watch --
 
     async def watch(self, key: Key, prefix: bool = False) -> Watcher:
         k = _b(key)
         hi = _prefix_end(k) if prefix else b""
-        tx, rx = await self._ep.connect1(self._addr)
+        tx, rx = await self._open_sub()
         tx.send(("watch", k, hi))
         head = await rx.recv()
         if head is None or head[0] != "ok":
+            tx.close()  # both ends release the failed subscription
             raise EtcdError(f"watch failed: {head}")
         return Watcher(tx, rx)
 

@@ -319,7 +319,12 @@ class _Conn:
     async def open(self, addr) -> None:
         await self._caller.open(addr)
 
-    _IDEMPOTENT = {"fetch", "metadata", "watermarks", "offsets_for_time", "committed"}
+    # commit_offsets is value-idempotent: it overwrites the same absolute
+    # offset, so re-sending after an ambiguous response loss cannot
+    # duplicate anything (and not retrying makes auto-commit poll() skip
+    # a delivered message whose position already advanced)
+    _IDEMPOTENT = {"fetch", "metadata", "watermarks", "offsets_for_time",
+                   "committed", "commit_offsets"}
 
     async def call(self, req: tuple):
         rsp = await self._caller.call(req, idempotent=req[0] in self._IDEMPOTENT)

@@ -201,6 +201,59 @@ def test_base_restart_if_honors_legacy_init_node_override():
     assert out2.volatile.tolist() == [1, 2, 3]  # cond gates everything
 
 
+def test_shipped_model_honors_legacy_init_node_override():
+    """A subclass of a shipped model that overrides only the legacy
+    init_node hook must get its restart semantics through the engine's
+    restart dispatch (review finding: it was silently ignored)."""
+    from madsim_tpu.models import kv as kvmod
+
+    class LegacyWipeKv(kvmod.KvMachine):
+        def init_node(self, nodes, i, rng_key):  # legacy hook only
+            # wipe EVERYTHING on restart, including the server's store
+            return self._wipe_node_if(nodes, i, jnp.bool_(True), rng_key)
+
+    m = LegacyWipeKv(4)
+    nodes = m.init(jax.random.PRNGKey(0))
+    nodes = nodes.replace(version=nodes.version + 7)
+    out = m.restart_node_if(nodes, jnp.int32(kvmod.SERVER), jnp.bool_(True), jax.random.PRNGKey(0))
+    assert int(out.version[kvmod.SERVER]) == 0  # legacy wipe applied
+    # and cond still gates it
+    out2 = m.restart_node_if(nodes, jnp.int32(kvmod.SERVER), jnp.bool_(False), jax.random.PRNGKey(0))
+    assert int(out2.version[kvmod.SERVER]) == 7
+    # the stock model keeps its durable-store fast path
+    stock = kvmod.KvMachine(4)
+    out3 = stock.restart_node_if(nodes, jnp.int32(kvmod.SERVER), jnp.bool_(True), jax.random.PRNGKey(0))
+    assert int(out3.version[kvmod.SERVER]) == 7  # durable across restart
+
+
+def test_legacy_init_node_calling_super_does_not_recurse():
+    """The historical VolatileEtcd pattern: a legacy init_node override
+    that calls super().init_node() (which shipped models implement by
+    delegating to restart_if) must not mutually recurse through the
+    dispatch (review finding)."""
+    from madsim_tpu.models import kv as kvmod
+
+    class LegacySuperKv(kvmod.KvMachine):
+        def init_node(self, nodes, i, rng_key):
+            # stock client reset first, then also wipe the server store
+            nodes = super().init_node(nodes, i, rng_key)
+            return self._wipe_node_if(nodes, i, jnp.bool_(True), rng_key)
+
+    m = LegacySuperKv(4)
+    nodes = m.init(jax.random.PRNGKey(0))
+    nodes = nodes.replace(version=nodes.version + 7, acked_version=nodes.acked_version + 3)
+    out = m.restart_node_if(nodes, jnp.int32(1), jnp.bool_(True), jax.random.PRNGKey(0))
+    assert int(out.version[1]) == 0 and int(out.acked_version[1]) == 0
+    # a new-style subclass overriding restart_if still wins the dispatch
+    class NewStyleKv(kvmod.KvMachine):
+        def restart_if(self, nodes, i, cond, rng_key):
+            return self._wipe_node_if(nodes, i, cond, rng_key)
+
+    m2 = NewStyleKv(4)
+    out2 = m2.restart_node_if(nodes, jnp.int32(kvmod.SERVER), jnp.bool_(True), jax.random.PRNGKey(0))
+    assert int(out2.version[kvmod.SERVER]) == 0
+
+
 def test_kv_machine_catches_durability_bug():
     """A KV server that loses state on restart must produce stale reads
     on some seeds (the etcd-class bug the workload exists to catch)."""
@@ -266,6 +319,47 @@ def test_mq_machine_catches_duplicate_bug():
     assert mqmod.DUP_OR_GAP in codes
     rp = replay(eng, int(failing[0]), max_steps=3000)
     assert rp.failed and rp.fail_code == mqmod.DUP_OR_GAP
+
+
+def test_twopc_atomicity_holds_under_chaos():
+    from madsim_tpu.models.twopc import TwoPcMachine
+
+    cfg = EngineConfig(
+        horizon_us=5_000_000, queue_capacity=64, packet_loss_rate=0.1,
+        faults=FaultPlan(n_faults=2, t_max_us=3_000_000, dur_min_us=100_000, dur_max_us=400_000),
+    )
+    eng = Engine(TwoPcMachine(4, 6), cfg)
+    res = eng.make_runner(max_steps=3000)(jnp.arange(48, dtype=jnp.uint32))
+    assert bool(res.done.all())
+    assert not bool(res.failed.any()), f"codes: {set(res.fail_code.tolist())}"
+    # every lane ran all transactions to a decided outcome
+    assert res.summary["txns"].tolist() == [6] * 48
+    total = res.summary["committed"] + res.summary["aborted"]
+    assert total.tolist() == [6] * 48
+    # the 1/8 NO-vote rate produces both outcomes across the batch
+    assert int(jnp.sum(res.summary["committed"])) > 0
+    assert int(jnp.sum(res.summary["aborted"])) > 0
+
+
+def test_twopc_catches_eager_commit_bug():
+    """A coordinator that presumes missing votes are YES must produce
+    mixed commit/abort outcomes (the textbook 2PC safety violation);
+    the failing seed replays bit-identically."""
+    from madsim_tpu.models import twopc as tp
+
+    class EagerCommitTwoPc(tp.TwoPcMachine):
+        def _all_votes_in(self, votes_recv):
+            # BUG: decide as soon as any vote arrives
+            return votes_recv != 0
+
+    eng = Engine(EagerCommitTwoPc(4, 6), EngineConfig(horizon_us=5_000_000, queue_capacity=64))
+    res = eng.make_runner(max_steps=3000)(jnp.arange(64, dtype=jnp.uint32))
+    failing = eng.failing_seeds(res).tolist()
+    assert len(failing) > 0, "eager-commit bug was not caught"
+    codes = {int(c) for c in res.fail_code.tolist() if c != 0}
+    assert codes == {tp.ATOMICITY}
+    rp = replay(eng, int(failing[0]), max_steps=3000)
+    assert rp.failed and rp.fail_code == tp.ATOMICITY
 
 
 def test_replay_diff_finds_divergence(echo_engine):

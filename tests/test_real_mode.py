@@ -201,3 +201,40 @@ asyncio.run(main())
     finally:
         server.kill()
         server.wait()
+
+
+def test_real_mode_server_down_is_typed_error(tmp_path):
+    """Connect-refused must surface as the drop-in client's typed error
+    (review finding: raw OSError escaped StreamCaller.call)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["MADSIM_TPU_MODE"] = "real"
+    env["PYTHONPATH"] = repo
+    code = """
+import asyncio
+from madsim_tpu.services import kafka, s3
+async def main():
+    cfg = kafka.ClientConfig({"bootstrap.servers": "127.0.0.1:9"})
+    prod = await cfg.create_future_producer()
+    try:
+        await prod.send_and_wait(kafka.FutureRecord("t", payload=b"x"))
+        raise AssertionError("expected KafkaError")
+    except kafka.KafkaError as e:
+        assert e.code == kafka.ErrorCode.TIMED_OUT, e
+    cli = s3.Client.from_conf(s3.Config(endpoint_url="http://127.0.0.1:9"))
+    try:
+        await cli.create_bucket().bucket("b").send()
+        raise AssertionError("expected S3Error")
+    except s3.S3Error:
+        pass
+    print("OK typed errors")
+asyncio.run(main())
+"""
+    script = tmp_path / "client_down.py"
+    script.write_text(code)
+    out = subprocess.run(
+        [sys.executable, str(script)], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK typed errors" in out.stdout, out.stdout

@@ -10,6 +10,7 @@ from madsim_tpu.models.echo import EchoMachine
 from madsim_tpu.models.kv import KvMachine
 from madsim_tpu.models.mq import MqMachine
 from madsim_tpu.models.raft import RaftMachine
+from madsim_tpu.models.twopc import TwoPcMachine
 
 
 CONFIGS = [
@@ -28,6 +29,10 @@ CONFIGS = [
                   faults=FaultPlan(n_faults=1, t_max_us=2_000_000))),
     ("echo-chaotic", lambda: EchoMachine(rounds=8),
      EngineConfig(horizon_us=20_000_000, queue_capacity=48, packet_loss_rate=0.2)),
+    ("twopc-killy", lambda: TwoPcMachine(5, 5),
+     EngineConfig(horizon_us=6_000_000, queue_capacity=96, packet_loss_rate=0.1,
+                  faults=FaultPlan(n_faults=2, t_max_us=3_000_000,
+                                   dur_min_us=100_000, dur_max_us=400_000))),
 ]
 
 
