@@ -179,8 +179,8 @@ class Machine:
         init_owner = hook_owner("init_node")
         rif_owner = hook_owner("restart_if")
         if init_owner is not Machine and mro.index(init_owner) < mro.index(rif_owner):
-            fresh = self.init_node(nodes, i, rng_key)
-            return jax.tree.map(lambda c, f: jnp.where(cond, f, c), nodes, fresh)
+            # the generic bridge; naming the base class cannot recurse
+            return Machine.restart_if(self, nodes, i, cond, rng_key)
         return self.restart_if(nodes, i, cond, rng_key)
 
     def on_timer(self, nodes: Any, node, timer_id, now_us, rand_u32) -> Tuple[Any, Outbox]:

@@ -58,6 +58,9 @@ class ErrorCode:
     TIMED_OUT = "TimedOut"
     INVALID_TXN_STATE = "InvalidTxnState"
     UNKNOWN_GROUP = "UnknownGroup"
+    UNKNOWN_MEMBER_ID = "UnknownMemberId"
+    ILLEGAL_GENERATION = "IllegalGeneration"
+    REBALANCE_IN_PROGRESS = "RebalanceInProgress"
     FAIL = "Fail"
 
 
@@ -107,6 +110,30 @@ class NewTopic:
 # -- broker state (reference: src/sim/broker.rs) ------------------------------
 
 
+class _GroupMember:
+    __slots__ = ("topics", "last_hb_ms", "session_ms")
+
+    def __init__(self, topics: Sequence[str], now_ms: int, session_ms: int):
+        self.topics = list(topics)
+        self.last_hb_ms = now_ms
+        self.session_ms = session_ms
+
+
+class _Group:
+    """Consumer-group coordinator state: generation-fenced membership
+    with broker-computed assignments (the classic-protocol subset:
+    join/sync/heartbeat/leave, range or roundrobin strategy)."""
+
+    __slots__ = ("generation", "members", "assignments", "next_member", "strategy")
+
+    def __init__(self) -> None:
+        self.generation = 0
+        self.members: Dict[str, _GroupMember] = {}
+        self.assignments: Dict[str, List[Tuple[str, int]]] = {}
+        self.next_member = 0
+        self.strategy = "range"
+
+
 class Partition:
     __slots__ = ("records",)
 
@@ -120,8 +147,11 @@ class Partition:
 
 
 class Broker:
-    """Reference: broker.rs:12-60 (+ committed-offset store, the
-    group-coordinator subset: one member per group, no rebalancing)."""
+    """Reference: broker.rs:12-60 (+ committed-offset store and a
+    consumer-group coordinator — classic-protocol subset with
+    join/sync/heartbeat/leave, range/roundrobin assignment,
+    session-timeout eviction and generation-fenced commits; the
+    reference sim has no groups at all)."""
 
     def __init__(self, message_max_bytes: int = 1_000_000) -> None:
         self.topics: Dict[str, List[Partition]] = {}
@@ -129,6 +159,7 @@ class Broker:
         self.message_max_bytes = message_max_bytes
         # (group, topic, partition) -> committed offset
         self.committed_offsets: Dict[Tuple[str, str, int], int] = {}
+        self.groups: Dict[str, _Group] = {}
 
     def create_topic(self, name: str, partitions: int) -> None:
         if name in self.topics:
@@ -200,9 +231,24 @@ class Broker:
 
     # -- committed offsets (the consumer-group subset) --
 
-    def commit_offsets(self, group: str, offsets: Dict[Tuple[str, int], int]) -> None:
+    def commit_offsets(
+        self,
+        group: str,
+        offsets: Dict[Tuple[str, int], int],
+        member_id: Optional[str] = None,
+        generation: Optional[int] = None,
+        now_ms: int = 0,
+    ) -> None:
+        """With (member_id, generation), the commit is generation-fenced:
+        a zombie member that missed a rebalance cannot clobber the new
+        owner's progress (classic-protocol commit semantics). Without
+        them, a simple consumer commits unfenced (real Kafka's
+        generation -1 path)."""
         if not group:
             raise KafkaError("group.id required to commit", ErrorCode.UNKNOWN_GROUP)
+        if member_id is not None:
+            self._coord_group(group, member_id, now_ms,
+                              generation, ErrorCode.ILLEGAL_GENERATION)
         for (topic, partition), off in offsets.items():
             self._partition(topic, partition)  # validates
             self.committed_offsets[(group, topic, partition)] = off
@@ -210,6 +256,126 @@ class Broker:
     def committed(self, group: str, topic: str, partition: int) -> Optional[int]:
         self._partition(topic, partition)
         return self.committed_offsets.get((group, topic, partition))
+
+    # -- group coordinator (classic protocol subset) --
+
+    def _rebalance(self, g: _Group) -> None:
+        g.generation += 1
+        g.assignments = {m: [] for m in g.members}
+        members = sorted(g.members)
+        topics = sorted({t for m in g.members.values() for t in m.topics})
+        for topic in topics:
+            parts = self.topics.get(topic)
+            if parts is None:
+                continue
+            subs = [m for m in members if topic in g.members[m].topics]
+            if not subs:
+                continue
+            n = len(parts)
+            if g.strategy == "roundrobin":
+                for p in range(n):
+                    g.assignments[subs[p % len(subs)]].append((topic, p))
+            else:
+                # range: contiguous chunks; the first n % m members get
+                # one extra partition (real range-assignor arithmetic)
+                base, extra = divmod(n, len(subs))
+                start = 0
+                for idx, m in enumerate(subs):
+                    take = base + (1 if idx < extra else 0)
+                    for p in range(start, start + take):
+                        g.assignments[m].append((topic, p))
+                    start += take
+
+    def _expire_members(self, g: _Group, now_ms: int) -> None:
+        dead = [
+            m for m, info in g.members.items()
+            if now_ms - info.last_hb_ms > info.session_ms
+        ]
+        for m in dead:
+            del g.members[m]
+        if dead:
+            self._rebalance(g)
+
+    def join_group(
+        self,
+        group: str,
+        member_id: Optional[str],
+        topics: Sequence[str],
+        session_ms: int,
+        strategy: str,
+        now_ms: int,
+    ) -> Tuple[str, int]:
+        if not group:
+            raise KafkaError("group.id required to join", ErrorCode.UNKNOWN_GROUP)
+        g = self.groups.setdefault(group, _Group())
+        self._expire_members(g, now_ms)
+        if not g.members and strategy:
+            g.strategy = strategy  # first joiner picks the strategy
+        if member_id is None or member_id not in g.members:
+            if member_id is None:
+                member_id = f"{group}-member-{g.next_member}"
+                g.next_member += 1
+            g.members[member_id] = _GroupMember(topics, now_ms, session_ms)
+            self._rebalance(g)
+        else:
+            mem = g.members[member_id]
+            mem.last_hb_ms = now_ms
+            if sorted(mem.topics) != sorted(topics):
+                mem.topics = list(topics)
+                self._rebalance(g)
+            # plain re-join after a rebalance notice: current generation
+        return member_id, g.generation
+
+    def sync_group(self, group: str, member_id: str, generation: int, now_ms: int) -> List[Tuple[str, int]]:
+        g = self._coord_group(group, member_id, now_ms, generation)
+        return list(g.assignments.get(member_id, []))
+
+    def heartbeat(self, group: str, member_id: str, generation: int, now_ms: int) -> None:
+        self._coord_group(group, member_id, now_ms, generation)
+
+    def leave_group(self, group: str, member_id: str, now_ms: int) -> None:
+        g = self.groups.get(group)
+        if g is None:
+            return
+        if member_id in g.members:
+            del g.members[member_id]
+            self._rebalance(g)
+        self._expire_members(g, now_ms)
+
+    def describe_group(self, group: str) -> dict:
+        g = self.groups.get(group)
+        if g is None:
+            raise KafkaError(f"unknown group: {group}", ErrorCode.UNKNOWN_GROUP)
+        return {
+            "generation": g.generation,
+            "strategy": g.strategy,
+            "members": {m: list(info.topics) for m, info in g.members.items()},
+            "assignments": {m: list(a) for m, a in g.assignments.items()},
+        }
+
+    def _coord_group(
+        self,
+        group: str,
+        member_id: str,
+        now_ms: int,
+        generation: Optional[int] = None,
+        stale_code: str = ErrorCode.REBALANCE_IN_PROGRESS,
+    ) -> _Group:
+        """Resolve + expire the group, validate the member, and (when
+        `generation` is given) fence it — the single fencing path for
+        sync/heartbeat/fenced-commit. A live check refreshes the
+        member's heartbeat clock."""
+        g = self.groups.get(group)
+        if g is not None:
+            self._expire_members(g, now_ms)
+        if g is None or member_id not in g.members:
+            raise KafkaError(f"unknown member: {member_id}", ErrorCode.UNKNOWN_MEMBER_ID)
+        if generation is not None and generation != g.generation:
+            raise KafkaError(
+                f"generation {generation} != {g.generation}", stale_code
+            )
+        g.members[member_id].last_hb_ms = now_ms
+        return g
 
 
 # -- server --------------------------------------------------------------------
@@ -238,6 +404,7 @@ class SimBroker:
         try:
             while (req := await rx.recv()) is not None:
                 kind = req[0]
+                now_ms = int(sim_time.now() * 1000)  # one clock per request
                 try:
                     if kind == "create_topic":
                         b.create_topic(req[1], req[2])
@@ -253,10 +420,26 @@ class SimBroker:
                     elif kind == "offsets_for_time":
                         rsp = b.offsets_for_time(req[1], req[2], req[3])
                     elif kind == "commit_offsets":
-                        b.commit_offsets(req[1], req[2])
+                        if len(req) > 3:  # generation-fenced commit
+                            b.commit_offsets(req[1], req[2], req[3], req[4],
+                                             now_ms=now_ms)
+                        else:
+                            b.commit_offsets(req[1], req[2])
                         rsp = None
                     elif kind == "committed":
                         rsp = b.committed(req[1], req[2], req[3])
+                    elif kind == "join_group":
+                        rsp = b.join_group(req[1], req[2], req[3], req[4], req[5], now_ms)
+                    elif kind == "sync_group":
+                        rsp = b.sync_group(req[1], req[2], req[3], now_ms)
+                    elif kind == "heartbeat":
+                        b.heartbeat(req[1], req[2], req[3], now_ms)
+                        rsp = None
+                    elif kind == "leave_group":
+                        b.leave_group(req[1], req[2], now_ms)
+                        rsp = None
+                    elif kind == "describe_group":
+                        rsp = b.describe_group(req[1])
                     else:
                         raise KafkaError(f"unknown request {kind}", ErrorCode.INVALID_ARG)
                     tx.send(("ok", rsp))
@@ -323,8 +506,12 @@ class _Conn:
     # offset, so re-sending after an ambiguous response loss cannot
     # duplicate anything (and not retrying makes auto-commit poll() skip
     # a delivered message whose position already advanced)
+    # group ops: heartbeat/sync re-send the same generation check and
+    # leave is a no-op on a gone member; join_group is NOT idempotent
+    # when member_id is None (a re-send would register a ghost member)
     _IDEMPOTENT = {"fetch", "metadata", "watermarks", "offsets_for_time",
-                   "committed", "commit_offsets"}
+                   "committed", "commit_offsets", "heartbeat", "sync_group",
+                   "leave_group", "describe_group"}
 
     async def call(self, req: tuple):
         rsp = await self._caller.call(req, idempotent=req[0] in self._IDEMPOTENT)
@@ -478,6 +665,14 @@ class BaseConsumer:
         self._group = ""
         self._auto_commit = True
         self._auto_reset = "earliest"
+        # group membership (classic protocol, driven from poll())
+        self._member_id: Optional[str] = None
+        self._generation = -1
+        self._sub_topics: List[str] = []
+        self._session_ms = 10_000
+        self._hb_interval = 3.0
+        self._strategy = "range"
+        self._next_hb = 0.0
 
     @staticmethod
     async def _create(cfg: ClientConfig) -> "BaseConsumer":
@@ -486,25 +681,101 @@ class BaseConsumer:
         c._auto_reset = cfg.get("auto.offset.reset", "earliest")
         c._group = cfg.get("group.id", "")
         c._auto_commit = cfg.get("enable.auto.commit", "true") not in ("false", "0")
+        c._session_ms = int(cfg.get("session.timeout.ms", "10000"))
+        c._hb_interval = int(cfg.get("heartbeat.interval.ms", "3000")) / 1000.0
+        c._strategy = cfg.get("partition.assignment.strategy", "range")
         return c
 
     async def subscribe(self, topics: Sequence[str]) -> None:
-        """Assign all partitions of the topics. With a `group.id`, each
-        partition resumes from the group's committed offset when one
-        exists, else from `auto.offset.reset` (the single-member
-        consumer-group subset: offsets persist at the broker, but there
-        is no rebalancing across members)."""
+        """With a `group.id`: join the consumer group — the broker's
+        coordinator assigns this member a share of the partitions
+        (range or roundrobin per `partition.assignment.strategy`) and
+        rebalances as members join/leave/expire; each owned partition
+        resumes from the group's committed offset. Without one: assign
+        all partitions from `auto.offset.reset`."""
         meta = await self._conn.call(("metadata",))
         for t in topics:
             if t not in meta:
                 raise KafkaError(f"unknown topic: {t}", ErrorCode.UNKNOWN_TOPIC_OR_PART)
+        if self._group:
+            self._sub_topics = list(topics)
+            await self._rejoin()
+            return
+        for t in topics:
             for partid in range(meta[t]):
                 start: Union[str, int] = (
-                    Offset.Stored
-                    if self._group
-                    else (Offset.Beginning if self._auto_reset == "earliest" else Offset.End)
+                    Offset.Beginning if self._auto_reset == "earliest" else Offset.End
                 )
                 await self.assign(t, partid, start)
+
+    async def unsubscribe(self) -> None:
+        """Leave the group (partitions move to the remaining members)."""
+        if self._member_id is not None:
+            await self._conn.call(("leave_group", self._group, self._member_id))
+            self._member_id = None
+            self._generation = -1
+        self._positions.clear()
+        self._sub_topics = []
+
+    async def close(self) -> None:
+        """Commit progress (auto-commit mode) and leave the group."""
+        if self._member_id is not None and self._auto_commit and self._positions:
+            try:
+                await self._commit_positions(dict(self._positions))
+            except KafkaError:
+                pass  # mid-rebalance: the new owner resumes from the last commit
+        await self.unsubscribe()
+
+    # -- group protocol plumbing (poll-driven, like rdkafka) --
+
+    async def _rejoin(self) -> None:
+        while True:
+            mid, gen = await self._conn.call(
+                ("join_group", self._group, self._member_id, list(self._sub_topics),
+                 self._session_ms, self._strategy)
+            )
+            self._member_id, self._generation = mid, gen
+            try:
+                parts = await self._conn.call(("sync_group", self._group, mid, gen))
+                break
+            except KafkaError as e:
+                if e.code != ErrorCode.REBALANCE_IN_PROGRESS:
+                    raise
+                # another member joined between our join and sync: loop
+                # (not recursion — churny groups would grow the stack)
+        old = self._positions
+        self._positions = {}
+        for (t, p) in parts:
+            if (t, p) in old:
+                self._positions[(t, p)] = old[(t, p)]  # keep live position
+            else:
+                await self.assign(t, p, Offset.Stored)
+        self._next_hb = sim_time.monotonic() + self._hb_interval
+
+    async def _heartbeat_tick(self) -> None:
+        if self._member_id is None or sim_time.monotonic() < self._next_hb:
+            return
+        try:
+            await self._conn.call(
+                ("heartbeat", self._group, self._member_id, self._generation)
+            )
+            self._next_hb = sim_time.monotonic() + self._hb_interval
+        except KafkaError as e:
+            if e.code in (ErrorCode.REBALANCE_IN_PROGRESS, ErrorCode.ILLEGAL_GENERATION):
+                await self._rejoin()
+            elif e.code == ErrorCode.UNKNOWN_MEMBER_ID:
+                self._member_id = None  # evicted: rejoin as a new member
+                await self._rejoin()
+            else:
+                raise
+
+    async def _commit_positions(self, offsets: Dict[Tuple[str, int], int]) -> None:
+        if self._member_id is not None:
+            await self._conn.call(
+                ("commit_offsets", self._group, offsets, self._member_id, self._generation)
+            )
+        else:
+            await self._conn.call(("commit_offsets", self._group, offsets))
 
     async def assign(self, topic: str, partition: int, offset: Union[str, int] = Offset.Beginning) -> None:
         if offset == Offset.Stored:
@@ -532,10 +803,11 @@ class BaseConsumer:
     # -- committed offsets (consumer-group subset) --
 
     async def commit(self) -> None:
-        """Commit current positions to the broker for this group.id."""
+        """Commit current positions to the broker for this group.id
+        (generation-fenced when this consumer is a group member)."""
         if not self._group:
             raise KafkaError("commit needs group.id", ErrorCode.UNKNOWN_GROUP)
-        await self._conn.call(("commit_offsets", self._group, dict(self._positions)))
+        await self._commit_positions(dict(self._positions))
 
     async def committed(self, topic: str, partition: int) -> Optional[int]:
         if not self._group:
@@ -549,14 +821,26 @@ class BaseConsumer:
         per-message; same observable at-least-once semantics)."""
         deadline = sim_time.monotonic() + timeout if timeout is not None else None
         while True:
+            await self._heartbeat_tick()  # drives rebalances, like rdkafka
             for (topic, part), pos in sorted(self._positions.items()):
                 msgs = await self._conn.call(("fetch", topic, part, pos, 1))
                 if msgs:
                     self._positions[(topic, part)] = msgs[0].offset + 1
                     if self._group and self._auto_commit:
-                        await self._conn.call(
-                            ("commit_offsets", self._group, {(topic, part): msgs[0].offset + 1})
-                        )
+                        try:
+                            await self._commit_positions(
+                                {(topic, part): msgs[0].offset + 1}
+                            )
+                        except KafkaError as e:
+                            if e.code in (ErrorCode.REBALANCE_IN_PROGRESS,
+                                          ErrorCode.ILLEGAL_GENERATION,
+                                          ErrorCode.UNKNOWN_MEMBER_ID):
+                                # mid-rebalance: deliver the message
+                                # (at-least-once) and rejoin on the next
+                                # poll's heartbeat
+                                self._next_hb = 0.0
+                            else:
+                                raise
                     return msgs[0]
             if deadline is not None and sim_time.monotonic() >= deadline:
                 return None
@@ -617,3 +901,8 @@ class AdminClient:
             except KafkaError as e:
                 results.append((t.name, str(e)))
         return results
+
+    async def describe_group(self, group: str) -> dict:
+        """Coordinator view of a consumer group: generation, strategy,
+        members with their subscriptions, and current assignments."""
+        return await self._conn.call(("describe_group", group))

@@ -533,6 +533,7 @@ def test_kafka_group_commit_and_resume():
             got1 = [(await c1.poll(1.0)).payload for _ in range(3)]
             await c1.commit()
             assert await c1.committed("t", 0) == 3
+            await c1.close()  # graceful shutdown releases the partitions
 
             # "restarted" consumer, same group: resumes at offset 3
             c2 = await gcfg.create_base_consumer()
@@ -550,6 +551,219 @@ def test_kafka_group_commit_and_resume():
             await a1.poll(1.0)
             await a1.poll(1.0)
             assert await a1.committed("t", 0) == 2
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def _kafka_broker(handle):
+    async def serve():
+        await kafka.SimBroker().serve("0.0.0.0:9092")
+
+    handle.create_node().name("broker").ip("10.7.0.1").init(serve).build()
+
+
+def test_kafka_consumer_group_rebalances_across_members():
+    """Two members split a 4-partition topic 2/2 (range assignment);
+    with stable ownership every record is delivered to exactly one
+    member; a third member triggers a rebalance both detect via
+    poll-driven heartbeats."""
+
+    async def main():
+        handle = Handle.current()
+        _kafka_broker(handle)
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.7.0.2").build()
+
+        async def go():
+            cfg = kafka.ClientConfig({"bootstrap.servers": "10.7.0.1:9092"})
+            admin = await cfg.create_admin()
+            await admin.create_topics([kafka.NewTopic("t", 4)])
+            gcfg = kafka.ClientConfig(
+                {"bootstrap.servers": "10.7.0.1:9092", "group.id": "g",
+                 "heartbeat.interval.ms": "100"}
+            )
+            c1 = await gcfg.create_base_consumer()
+            await c1.subscribe(["t"])
+            g1 = await admin.describe_group("g")
+            assert len(g1["members"]) == 1
+            assert sorted(len(a) for a in g1["assignments"].values()) == [4]
+
+            c2 = await gcfg.create_base_consumer()
+            await c2.subscribe(["t"])
+            # c1 notices the rebalance on its next heartbeat
+            await c1.poll(0.3)
+            g2 = await admin.describe_group("g")
+            assert len(g2["members"]) == 2
+            assert sorted(len(a) for a in g2["assignments"].values()) == [2, 2]
+            assert g2["generation"] > g1["generation"]
+
+            # stable ownership: each record goes to exactly one member
+            prod = await cfg.create_base_producer()
+            for i in range(20):
+                prod.send(kafka.BaseRecord("t", payload=b"m%d" % i, partition=i % 4))
+            await prod.flush()
+            got1, got2 = [], []
+            for _ in range(40):
+                m1 = await c1.poll(0.05)
+                if m1 is not None:
+                    got1.append(m1)
+                m2 = await c2.poll(0.05)
+                if m2 is not None:
+                    got2.append(m2)
+                if len(got1) + len(got2) >= 20:
+                    break
+            assert len(got1) + len(got2) == 20
+            assert {m.payload for m in got1} | {m.payload for m in got2} == {
+                b"m%d" % i for i in range(20)
+            }
+            # each member only consumed its own partitions
+            parts1 = {m.partition for m in got1}
+            parts2 = {m.partition for m in got2}
+            assert parts1.isdisjoint(parts2)
+            assert len(parts1) == len(parts2) == 2
+
+            # third member: both incumbents re-sync to a 2/1/1 split
+            c3 = await gcfg.create_base_consumer()
+            await c3.subscribe(["t"])
+            await c1.poll(0.3)
+            await c2.poll(0.3)
+            g3 = await admin.describe_group("g")
+            assert sorted(len(a) for a in g3["assignments"].values()) == [1, 1, 2]
+            # graceful leave redistributes back to 2/2
+            await c3.close()
+            await c1.poll(0.3)
+            await c2.poll(0.3)
+            g4 = await admin.describe_group("g")
+            assert sorted(len(a) for a in g4["assignments"].values()) == [2, 2]
+            return sorted(m.payload for m in got1 + got2)
+
+        return await c.spawn(go())
+
+    assert run(main) == run(main)  # and the whole dance is deterministic
+
+
+def test_kafka_group_session_timeout_evicts_dead_member():
+    """A member that stops polling misses heartbeats; the coordinator
+    evicts it after session.timeout.ms and the survivor takes over all
+    partitions (detected lazily on the survivor's next heartbeat)."""
+
+    async def main():
+        handle = Handle.current()
+        _kafka_broker(handle)
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.7.0.2").build()
+
+        async def go():
+            cfg = kafka.ClientConfig({"bootstrap.servers": "10.7.0.1:9092"})
+            admin = await cfg.create_admin()
+            await admin.create_topics([kafka.NewTopic("t", 2)])
+            gcfg = kafka.ClientConfig(
+                {"bootstrap.servers": "10.7.0.1:9092", "group.id": "g",
+                 "session.timeout.ms": "500", "heartbeat.interval.ms": "100"}
+            )
+            c1 = await gcfg.create_base_consumer()
+            await c1.subscribe(["t"])
+            c2 = await gcfg.create_base_consumer()
+            await c2.subscribe(["t"])
+            await c1.poll(0.3)  # settle into the 1/1 split
+            assert len((await admin.describe_group("g"))["members"]) == 2
+
+            # c2 goes silent; c1 keeps polling past the session timeout
+            prod = await cfg.create_base_producer()
+            for i in range(4):
+                prod.send(kafka.BaseRecord("t", payload=b"m%d" % i, partition=i % 2))
+            await prod.flush()
+            got = []
+            for _ in range(30):
+                m = await c1.poll(0.1)
+                if m is not None:
+                    got.append(m)
+                if len(got) >= 4:
+                    break
+            # survivor owns both partitions and consumed everything
+            desc = await admin.describe_group("g")
+            assert len(desc["members"]) == 1
+            assert {m.partition for m in got} == {0, 1}
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_kafka_group_zombie_commit_fenced():
+    """A member holding a stale generation cannot commit (classic
+    zombie-fencing): its commit raises IllegalGeneration after another
+    member's join bumped the generation."""
+
+    async def main():
+        handle = Handle.current()
+        _kafka_broker(handle)
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.7.0.2").build()
+
+        async def go():
+            cfg = kafka.ClientConfig({"bootstrap.servers": "10.7.0.1:9092"})
+            await (await cfg.create_admin()).create_topics([kafka.NewTopic("t", 2)])
+            prod = await cfg.create_base_producer()
+            prod.send(kafka.BaseRecord("t", payload=b"x", partition=0))
+            await prod.flush()
+
+            gcfg = kafka.ClientConfig(
+                {"bootstrap.servers": "10.7.0.1:9092", "group.id": "g",
+                 "enable.auto.commit": "false"}
+            )
+            c1 = await gcfg.create_base_consumer()
+            await c1.subscribe(["t"])
+            assert (await c1.poll(1.0)).payload == b"x"
+            # another member joins: generation bumps, c1 is now stale
+            c2 = await gcfg.create_base_consumer()
+            await c2.subscribe(["t"])
+            try:
+                await c1.commit()
+                raise AssertionError("stale-generation commit must be fenced")
+            except kafka.KafkaError as e:
+                assert e.code == kafka.ErrorCode.ILLEGAL_GENERATION
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_kafka_group_roundrobin_strategy():
+    """partition.assignment.strategy=roundrobin interleaves partitions
+    across members instead of range's contiguous chunks."""
+
+    async def main():
+        handle = Handle.current()
+        _kafka_broker(handle)
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.7.0.2").build()
+
+        async def go():
+            cfg = kafka.ClientConfig({"bootstrap.servers": "10.7.0.1:9092"})
+            admin = await cfg.create_admin()
+            await admin.create_topics([kafka.NewTopic("t", 3)])
+            gcfg = kafka.ClientConfig(
+                {"bootstrap.servers": "10.7.0.1:9092", "group.id": "g",
+                 "heartbeat.interval.ms": "100",
+                 "partition.assignment.strategy": "roundrobin"}
+            )
+            c1 = await gcfg.create_base_consumer()
+            await c1.subscribe(["t"])
+            c2 = await gcfg.create_base_consumer()
+            await c2.subscribe(["t"])
+            await c1.poll(0.3)
+            desc = await admin.describe_group("g")
+            assert desc["strategy"] == "roundrobin"
+            by_member = sorted(
+                sorted(p for _t, p in parts) for parts in desc["assignments"].values()
+            )
+            assert by_member == [[0, 2], [1]]
             return True
 
         return await c.spawn(go())
