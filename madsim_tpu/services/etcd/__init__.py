@@ -28,6 +28,7 @@ from .service import EtcdError, EtcdService, Event, KeyValue, MAX_REQUEST_BYTES
 __all__ = [
     "Client",
     "SimServer",
+    "WatchFilter",
     "EtcdError",
     "KeyValue",
     "Event",
@@ -116,13 +117,18 @@ class Txn:
 class SimServer:
     """Reference: src/server.rs `SimServer` (+ sim.rs builder)."""
 
-    def __init__(self, timeout_rate: float = 0.0):
+    def __init__(self, timeout_rate: float = 0.0, progress_interval: float = 1.0,
+                 history_limit: int = 10_000):
         self.timeout_rate = timeout_rate
+        # period of watch progress notifications (etcd's is ~10 min wall
+        # time; 1 s of virtual time keeps sim tests snappy)
+        self.progress_interval = progress_interval
+        self.history_limit = history_limit
         self.service: Optional[EtcdService] = None
 
     async def serve(self, addr: Any, on_bound=None) -> None:
         rng = rand.thread_rng()
-        self.service = EtcdService(rng)
+        self.service = EtcdService(rng, history_limit=self.history_limit)
         ep = await Endpoint.bind(addr)
         if on_bound is not None:
             on_bound(ep)
@@ -156,7 +162,8 @@ class SimServer:
                     continue
                 kind = req[0]
                 if kind == "watch":
-                    await self._watch(tx, rx, req[1], req[2])
+                    await self._watch(tx, rx, req[1], req[2],
+                                      req[3] if len(req) > 3 else {})
                     return
                 if kind == "observe":
                     await self._observe(tx, rx, req[1])
@@ -199,6 +206,8 @@ class SimServer:
             return svc.proclaim(req[1], req[2])
         if kind == "resign":
             return svc.resign(req[1])
+        if kind == "compact":
+            return svc.compact(req[1])
         if kind == "status":
             return svc.status()
         if kind == "dump":
@@ -207,21 +216,75 @@ class SimServer:
             return svc.load(req[1])
         raise EtcdError(f"unknown request {kind}")
 
-    async def _watch(self, tx, rx, lo: bytes, hi: bytes) -> None:
+    async def _watch(self, tx, rx, lo: bytes, hi: bytes, opts: dict) -> None:
+        """WatchCreateRequest options (reference class: etcd v3 watch —
+        the reference sim's watch.rs is a type stub; this is functional):
+        `filters` ("noput"/"nodelete"), `prev_kv`, `start_revision`
+        (history replay, ErrCompacted past the compaction point), and
+        `progress_notify` (periodic revision heartbeats; the client can
+        also request one on demand, like WatchProgressRequest)."""
         svc = self.service
-        entry = svc.add_watcher(lo, hi, lambda ev: self._safe_send(tx, ("event", ev), entry_box))
-        entry_box = entry
-        tx.send(("ok", {"watching": True}))
-        # hold open until the client goes away
-        while (await rx.recv()) is not None:
-            pass
+        filters = set(opts.get("filters", ()))
+        want_prev = opts.get("prev_kv", False)
+        start_rev = opts.get("start_revision", 0)
+        entry_box: list = [None]
+
+        def emit(ev: Event) -> None:
+            if ev.kind == Event.PUT and "noput" in filters:
+                return
+            if ev.kind == Event.DELETE and "nodelete" in filters:
+                return
+            if not want_prev and ev.prev_kv is not None:
+                ev = Event(ev.kind, ev.kv, None)
+            self._safe_send(tx, ("event", ev), entry_box)
+
+        # no awaits between head/replay/subscribe: the deterministic
+        # executor makes this block atomic, so replay never races a
+        # concurrent put (no gap, no duplicate)
+        if start_rev:
+            try:
+                backlog = svc.history_since(start_rev, lo, hi)
+            except EtcdError as e:
+                tx.send(("err", str(e)))
+                return
+            tx.send(("ok", {"watching": True}))
+            for ev in backlog:
+                emit(ev)
+        else:
+            tx.send(("ok", {"watching": True}))
+        entry_box[0] = entry = svc.add_watcher(lo, hi, emit)
+
+        stop = [False]
+        if opts.get("progress_notify", False):
+            async def ticker():
+                while not stop[0]:
+                    await sim_time.sleep(self.progress_interval)
+                    if stop[0]:
+                        return
+                    try:
+                        tx.send(("progress", svc.revision))
+                    except ConnectionReset:
+                        return
+
+            spawn(ticker(), name="etcd-watch-progress")
+
+        # hold open until the client goes away; serve manual progress
+        # requests in the meantime
+        while (req := await rx.recv()) is not None:
+            if req and req[0] == "progress_req":
+                try:
+                    tx.send(("progress", svc.revision))
+                except ConnectionReset:
+                    break
+        stop[0] = True
         svc.remove_watcher(entry)
 
-    def _safe_send(self, tx, msg, entry) -> None:
+    def _safe_send(self, tx, msg, entry_box) -> None:
         try:
             tx.send(msg)
         except ConnectionReset:
-            self.service.remove_watcher(entry)
+            if entry_box[0] is not None:
+                self.service.remove_watcher(entry_box[0])
 
     async def _observe(self, tx, rx, name: bytes) -> None:
         """Stream leadership changes (reference: election observe)."""
@@ -249,22 +312,53 @@ class SimServer:
 # -- client -------------------------------------------------------------------
 
 
+class WatchFilter:
+    """Event-type filters for watch (reference class: etcd v3
+    WatchCreateRequest.filters)."""
+
+    NOPUT = "noput"
+    NODELETE = "nodelete"
+
+
 class Watcher:
     """Async stream of watch events (functional, unlike the reference's
-    stub watch.rs)."""
+    stub watch.rs). Progress notifications never surface as events:
+    they update `progress_revision` (the keyspace revision the stream is
+    guaranteed to have reached) and can be requested on demand with
+    `progress()`."""
 
     def __init__(self, tx, rx):
         self._tx = tx
         self._rx = rx
+        self._pending: List[tuple] = []
+        self.progress_revision = 0
 
     def __aiter__(self) -> "Watcher":
         return self
 
     async def __anext__(self) -> Event:
-        msg = await self._rx.recv()
-        if msg is None:
-            raise StopAsyncIteration
-        return msg[1]
+        while True:
+            msg = self._pending.pop(0) if self._pending else await self._rx.recv()
+            if msg is None:
+                raise StopAsyncIteration
+            if msg[0] == "progress":
+                self.progress_revision = msg[1]
+                continue
+            return msg[1]
+
+    async def progress(self) -> int:
+        """Request + await a progress notification (reference class:
+        etcd WatchProgressRequest); events arriving in between are
+        buffered for the next `__anext__`."""
+        self._tx.send(("progress_req",))
+        while True:
+            msg = await self._rx.recv()
+            if msg is None:
+                raise EtcdError("watch stream closed")
+            if msg[0] == "progress":
+                self.progress_revision = msg[1]
+                return msg[1]
+            self._pending.append(msg)
 
     def cancel(self) -> None:
         self._tx.close()
@@ -401,16 +495,42 @@ class Client:
 
     # -- watch --
 
-    async def watch(self, key: Key, prefix: bool = False) -> Watcher:
+    async def watch(
+        self,
+        key: Key,
+        prefix: bool = False,
+        range_end: Optional[Key] = None,
+        start_revision: int = 0,
+        filters: Sequence[str] = (),
+        prev_kv: bool = False,
+        progress_notify: bool = False,
+    ) -> Watcher:
+        """WatchCreateRequest surface: `start_revision` replays history
+        from that revision (ErrCompacted if compacted away), `filters`
+        drop event kinds (WatchFilter.NOPUT/NODELETE), `prev_kv`
+        includes each event's previous value, `progress_notify` enables
+        periodic revision heartbeats."""
         k = _b(key)
-        hi = _prefix_end(k) if prefix else b""
+        if range_end is not None:
+            hi = _b(range_end)
+        else:
+            hi = _prefix_end(k) if prefix else b""
         tx, rx = await self._open_sub()
-        tx.send(("watch", k, hi))
+        tx.send(("watch", k, hi, {
+            "start_revision": start_revision,
+            "filters": tuple(filters),
+            "prev_kv": prev_kv,
+            "progress_notify": progress_notify,
+        }))
         head = await rx.recv()
         if head is None or head[0] != "ok":
             tx.close()  # both ends release the failed subscription
             raise EtcdError(f"watch failed: {head}")
         return Watcher(tx, rx)
+
+    async def compact(self, revision: int):
+        """Discard watchable history below `revision` (etcd compaction)."""
+        return await self._call(("compact", revision))
 
     # -- maintenance / persistence --
 

@@ -9,6 +9,7 @@ dump/load (:160).
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ...errors import SimError
@@ -66,8 +67,12 @@ class Event:
 class EtcdService:
     """Reference: service.rs `EtcdService`."""
 
-    def __init__(self, rng):
+    def __init__(self, rng, history_limit: int = 10_000):
         self.rng = rng
+        # watchable-history bound: exceeding it auto-compacts the oldest
+        # whole revisions away (a real etcd bounds history by compaction
+        # too; without this a write-heavy run leaks one Event per put)
+        self.history_limit = history_limit
         self.revision = 1
         self.kv: Dict[bytes, KeyValue] = {}
         # lease id -> (granted_ttl, remaining_ttl)
@@ -75,6 +80,11 @@ class EtcdService:
         self.lease_keys: Dict[int, set] = {}
         # watchers: fn(event) -> None (detached on error by caller)
         self.watchers: List[Tuple[bytes, bytes, Callable[[Event], None]]] = []
+        # event history for watch start_revision replay (bounded by
+        # compaction, like etcd's MVCC keyspace history); deque so the
+        # steady-state trim is O(1) per write, not a list rebuild
+        self.history: "deque[Tuple[int, Event]]" = deque()
+        self.compact_revision = 0
 
     # -- helpers --------------------------------------------------------------
 
@@ -82,10 +92,54 @@ class EtcdService:
         self.revision += 1
         return self.revision
 
+    @staticmethod
+    def _in_range(key: bytes, lo: bytes, hi: bytes) -> bool:
+        """Range convention shared by get/delete/watch/replay:
+        hi == b"" means the single key `lo`, not unbounded-above
+        (watch previously disagreed with _keys_in here and delivered
+        every key >= lo to a single-key watcher)."""
+        if hi == b"":
+            return key == lo
+        return lo <= key < hi
+
     def _notify(self, ev: Event) -> None:
+        self.history.append((ev.kv.mod_revision, ev))
+        if len(self.history) > self.history_limit:
+            # drop whole revisions only: a range delete emits several
+            # events at one revision, and replaying half of one would
+            # silently lose data
+            boundary = self.history[0][0]
+            while len(self.history) > self.history_limit:
+                boundary = self.history.popleft()[0]
+            while self.history and self.history[0][0] == boundary:
+                self.history.popleft()
+            self.compact_revision = max(self.compact_revision, boundary + 1)
         for lo, hi, cb in list(self.watchers):
-            if lo <= ev.kv.key and (hi == b"" or ev.kv.key < hi):
+            if self._in_range(ev.kv.key, lo, hi):
                 cb(ev)
+
+    def history_since(self, start_revision: int, lo: bytes, hi: bytes) -> List[Event]:
+        """Replay events at mod_revision >= start_revision in [lo, hi).
+        Raises if the range was compacted away (etcd: ErrCompacted —
+        only revisions strictly BELOW the compaction point are gone;
+        compact(R) retains the events at R itself)."""
+        if start_revision < self.compact_revision:
+            raise EtcdError("etcdserver: mvcc: required revision has been compacted")
+        return [
+            ev for rev, ev in self.history
+            if rev >= start_revision and self._in_range(ev.kv.key, lo, hi)
+        ]
+
+    def compact(self, revision: int) -> dict:
+        """Discard event history below `revision`
+        (reference class: etcd Maintenance/KV compact)."""
+        if revision > self.revision:
+            raise EtcdError("etcdserver: mvcc: required revision is a future revision")
+        if revision <= self.compact_revision:
+            raise EtcdError("etcdserver: mvcc: required revision has been compacted")
+        self.compact_revision = revision
+        self.history = deque((r, e) for r, e in self.history if r >= revision)
+        return {"revision": self.revision, "compact_revision": revision}
 
     def add_watcher(self, lo: bytes, hi: bytes, cb: Callable[[Event], None]):
         entry = (lo, hi, cb)
@@ -323,6 +377,13 @@ class EtcdService:
 
         data = json.loads(text)
         self.revision = data["revision"]
+        # loaded state has no event history: watchers cannot replay
+        # revisions up to and including the load point (compaction at R
+        # retains R, so the boundary must sit one past the last missing
+        # revision or a start_revision==revision watch would silently
+        # skip that revision's events)
+        self.history = deque()
+        self.compact_revision = self.revision + 1
         self.kv = {}
         for d in data["kv"]:
             kv = KeyValue.from_dict(d)

@@ -264,6 +264,23 @@ class Broker:
         g.assignments = {m: [] for m in g.members}
         members = sorted(g.members)
         topics = sorted({t for m in g.members.values() for t in m.topics})
+        if g.strategy == "roundrobin":
+            # one circular pass over ALL topic-partitions (Kafka's
+            # RoundRobinAssignor: interleaving per topic would hand every
+            # single-partition topic to the same first member)
+            idx = 0
+            for topic in topics:
+                parts = self.topics.get(topic)
+                if parts is None or not any(
+                    topic in g.members[m].topics for m in members
+                ):
+                    continue
+                for p in range(len(parts)):
+                    while topic not in g.members[members[idx % len(members)]].topics:
+                        idx += 1
+                    g.assignments[members[idx % len(members)]].append((topic, p))
+                    idx += 1
+            return
         for topic in topics:
             parts = self.topics.get(topic)
             if parts is None:
@@ -271,20 +288,15 @@ class Broker:
             subs = [m for m in members if topic in g.members[m].topics]
             if not subs:
                 continue
-            n = len(parts)
-            if g.strategy == "roundrobin":
-                for p in range(n):
-                    g.assignments[subs[p % len(subs)]].append((topic, p))
-            else:
-                # range: contiguous chunks; the first n % m members get
-                # one extra partition (real range-assignor arithmetic)
-                base, extra = divmod(n, len(subs))
-                start = 0
-                for idx, m in enumerate(subs):
-                    take = base + (1 if idx < extra else 0)
-                    for p in range(start, start + take):
-                        g.assignments[m].append((topic, p))
-                    start += take
+            # range: contiguous chunks per topic; the first n % m members
+            # get one extra partition (real range-assignor arithmetic)
+            base, extra = divmod(len(parts), len(subs))
+            start = 0
+            for idx, m in enumerate(subs):
+                take = base + (1 if idx < extra else 0)
+                for p in range(start, start + take):
+                    g.assignments[m].append((topic, p))
+                start += take
 
     def _expire_members(self, g: _Group, now_ms: int) -> None:
         dead = [
@@ -342,10 +354,14 @@ class Broker:
             self._rebalance(g)
         self._expire_members(g, now_ms)
 
-    def describe_group(self, group: str) -> dict:
+    def describe_group(self, group: str, now_ms: int = 0) -> dict:
         g = self.groups.get(group)
         if g is None:
             raise KafkaError(f"unknown group: {group}", ErrorCode.UNKNOWN_GROUP)
+        # reflect session-timeout semantics even when no member traffic
+        # triggers eviction (a dead group would otherwise show its
+        # corpse's assignments forever)
+        self._expire_members(g, now_ms)
         return {
             "generation": g.generation,
             "strategy": g.strategy,
@@ -439,7 +455,7 @@ class SimBroker:
                         b.leave_group(req[1], req[2], now_ms)
                         rsp = None
                     elif kind == "describe_group":
-                        rsp = b.describe_group(req[1])
+                        rsp = b.describe_group(req[1], now_ms)
                     else:
                         raise KafkaError(f"unknown request {kind}", ErrorCode.INVALID_ARG)
                     tx.send(("ok", rsp))
@@ -764,7 +780,12 @@ class BaseConsumer:
             if e.code in (ErrorCode.REBALANCE_IN_PROGRESS, ErrorCode.ILLEGAL_GENERATION):
                 await self._rejoin()
             elif e.code == ErrorCode.UNKNOWN_MEMBER_ID:
-                self._member_id = None  # evicted: rejoin as a new member
+                # evicted: rejoin as a new member. In-memory positions are
+                # stale — another member may have consumed and committed
+                # past them while we were out; keeping them would rewind
+                # the group's committed offsets on our next auto-commit.
+                self._member_id = None
+                self._positions.clear()
                 await self._rejoin()
             else:
                 raise

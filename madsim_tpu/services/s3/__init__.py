@@ -53,8 +53,8 @@ class S3Service:
     def __init__(self, rng):
         self.rng = rng
         self.buckets: Dict[str, Dict[str, _Object]] = {}
-        # upload_id -> (bucket, key, {part_number: bytes})
-        self.uploads: Dict[str, Tuple[str, str, Dict[int, bytes]]] = {}
+        # upload_id -> (bucket, key, {part_number: bytes}, created_at)
+        self.uploads: Dict[str, Tuple[str, str, Dict[int, bytes], float]] = {}
         self.lifecycle: Dict[str, dict] = {}
 
     def _bucket(self, name: str) -> Dict[str, _Object]:
@@ -206,10 +206,10 @@ class S3Service:
 
     # -- multipart (reference: src/operation/{create,upload,complete,abort}_*) --
 
-    def create_multipart_upload(self, bucket: str, key: str) -> dict:
+    def create_multipart_upload(self, bucket: str, key: str, now: float = 0.0) -> dict:
         self._bucket(bucket)
         upload_id = format(self.rng.next_u64(), "032x")
-        self.uploads[upload_id] = (bucket, key, {})
+        self.uploads[upload_id] = (bucket, key, {}, now)
         return {"upload_id": upload_id}
 
     def upload_part(self, upload_id: str, part_number: int, body: bytes) -> dict:
@@ -223,7 +223,7 @@ class S3Service:
     def complete_multipart_upload(self, upload_id: str, now: float) -> dict:
         if upload_id not in self.uploads:
             raise S3Error("NoSuchUpload", upload_id)
-        bucket, key, parts = self.uploads.pop(upload_id)
+        bucket, key, parts, _created = self.uploads.pop(upload_id)
         body = b"".join(parts[n] for n in sorted(parts))
         return self.put_object(bucket, key, body, now)
 
@@ -244,11 +244,47 @@ class S3Service:
         self._bucket(bucket)
         return self.lifecycle.get(bucket, {"rules": []})
 
+    def apply_lifecycle(self, now: float) -> dict:
+        """Enforce lifecycle rules against the (virtual) clock — the
+        background job a real S3 runs ~daily. Rule shape:
+        {"id", "status" (default Enabled), "prefix", "days" (object
+        expiration), "abort_multipart_days" (incomplete-upload abort)}.
+        """
+        expired: List[Tuple[str, str]] = []
+        aborted: List[str] = []
+        for bucket, cfg in self.lifecycle.items():
+            b = self.buckets.get(bucket)
+            if b is None:
+                continue
+            for rule in cfg.get("rules", []):
+                if rule.get("status", "Enabled") != "Enabled":
+                    continue
+                prefix = rule.get("prefix", "")
+                days = rule.get("days")
+                if days is not None:
+                    cutoff = now - days * 86400.0
+                    for k in [k for k, o in b.items()
+                              if k.startswith(prefix) and o.last_modified <= cutoff]:
+                        del b[k]
+                        expired.append((bucket, k))
+                mp_days = rule.get("abort_multipart_days")
+                if mp_days is not None:
+                    cutoff = now - mp_days * 86400.0
+                    for uid in [uid for uid, (ub, uk, _p, created) in self.uploads.items()
+                                if ub == bucket and uk.startswith(prefix)
+                                and created <= cutoff]:
+                        del self.uploads[uid]
+                        aborted.append(uid)
+        return {"expired": expired, "aborted_uploads": aborted}
+
 
 class SimServer:
     """Reference: src/server/rpc_server.rs `SimServer`."""
 
-    def __init__(self) -> None:
+    def __init__(self, lifecycle_interval: float = 3600.0) -> None:
+        # period of the lifecycle enforcement job (a real S3 runs it
+        # ~daily; an hour of virtual time keeps sim behavior observable)
+        self.lifecycle_interval = lifecycle_interval
         self.service: Optional[S3Service] = None
 
     async def serve(self, addr: Any, on_bound=None) -> None:
@@ -256,6 +292,14 @@ class SimServer:
         ep = await Endpoint.bind(addr)
         if on_bound is not None:
             on_bound(ep)
+
+        async def lifecycle_ticker():
+            it = sim_time.interval(self.lifecycle_interval)
+            while True:
+                await it.tick()
+                self.service.apply_lifecycle(sim_time.now())
+
+        spawn(lifecycle_ticker(), name="s3-lifecycle-tick")
         while True:
             tx, rx, _peer = await ep.accept1()
             spawn(self._handle(tx, rx), name="s3-conn")
@@ -269,7 +313,8 @@ class SimServer:
                     fn = getattr(svc, op, None)
                     if fn is None:
                         raise S3Error("NotImplemented", op)
-                    if op in ("put_object", "copy_object", "complete_multipart_upload"):
+                    if op in ("put_object", "copy_object", "complete_multipart_upload",
+                              "create_multipart_upload"):
                         params = {**params, "now": sim_time.now()}
                     tx.send(("ok", fn(**params)))
                 except S3Error as e:

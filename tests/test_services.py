@@ -161,6 +161,191 @@ def test_etcd_watch_and_dump_load():
     assert run(main)
 
 
+def test_etcd_watch_filters_prevkv_and_start_revision():
+    """WatchCreateRequest options: NOPUT/NODELETE filters, prev_kv
+    population, history replay from start_revision, and ErrCompacted
+    once the requested revision is compacted away."""
+
+    async def main():
+        handle = Handle.current()
+        await _etcd_node(handle)
+        c = handle.create_node().ip("10.6.0.2").build()
+
+        async def go():
+            cli = await etcd.Client.connect("10.6.0.1:2379")
+            rev0 = (await cli.put("w/a", "1"))["revision"]
+            await cli.put("w/a", "2")
+            await cli.delete("w/a")
+            await cli.put("w/b", "3")
+
+            # replay everything from rev0: 4 events, prev_kv populated
+            w = await cli.watch("w/", prefix=True, start_revision=rev0, prev_kv=True)
+            evs = [await w.__anext__() for _ in range(4)]
+            assert [(e.kind, e.kv.key) for e in evs] == [
+                ("put", b"w/a"), ("put", b"w/a"), ("delete", b"w/a"), ("put", b"w/b"),
+            ]
+            assert evs[0].prev_kv is None  # first put: no previous value
+            assert evs[1].prev_kv.value == b"1"
+            assert evs[2].prev_kv.value == b"2"
+            w.cancel()
+
+            # NODELETE filter: deletions never surface
+            w2 = await cli.watch("w/", prefix=True,
+                                 filters=[etcd.WatchFilter.NODELETE])
+            await cli.put("w/c", "4")
+            await cli.delete("w/c")
+            await cli.put("w/d", "5")
+            e1 = await w2.__anext__()
+            e2 = await w2.__anext__()
+            assert [(e1.kind, e1.kv.key), (e2.kind, e2.kv.key)] == [
+                ("put", b"w/c"), ("put", b"w/d"),
+            ]
+            # without prev_kv, events carry no previous value
+            assert e1.prev_kv is None
+            w2.cancel()
+
+            # compaction: replay below the compaction point is refused
+            status = await cli.status()
+            await cli.compact(status["revision"])
+            try:
+                await cli.watch("w/", prefix=True, start_revision=rev0)
+                raise AssertionError("expected ErrCompacted")
+            except etcd.EtcdError as e:
+                assert "compacted" in str(e)
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_etcd_watch_progress_notify():
+    """Progress notifications report the current revision with no events
+    pending — both periodic (progress_notify) and on demand."""
+
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await etcd.SimServer(progress_interval=0.5).serve("0.0.0.0:2379")
+
+        handle.create_node().name("etcd").ip("10.6.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.6.0.2").build()
+
+        async def go():
+            cli = await etcd.Client.connect("10.6.0.1:2379")
+            await cli.put("x", "1")
+            w = await cli.watch("w/", prefix=True, progress_notify=True)
+            assert w.progress_revision == 0
+            # on-demand progress (WatchProgressRequest)
+            rev = await w.progress()
+            assert rev == (await cli.status())["revision"]
+            # periodic notifications advance progress_revision with no
+            # events flowing on this key range
+            await cli.put("y", "2")  # outside w/ -> no event
+            await sim_time.sleep(2.0)
+            rev2 = await w.progress()
+            assert rev2 >= rev + 1  # saw the y put's revision
+            # events still flow after progress traffic
+            await cli.put("w/k", "v")
+            ev = await w.__anext__()
+            assert (ev.kind, ev.kv.key) == ("put", b"w/k")
+            assert w.progress_revision >= rev2
+            w.cancel()
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_etcd_single_key_watch_is_single_key():
+    """watch(key) without prefix must deliver only that key's events
+    (review finding: the watcher treated range_end=b"" as unbounded and
+    received every key >= the watched one)."""
+
+    async def main():
+        handle = Handle.current()
+        await _etcd_node(handle)
+        c = handle.create_node().ip("10.6.0.2").build()
+
+        async def go():
+            cli = await etcd.Client.connect("10.6.0.1:2379")
+            w = await cli.watch("a")
+            await cli.put("b", "other")
+            await cli.put("zzz", "far")
+            await cli.put("a", "mine")
+            ev = await w.__anext__()
+            assert (ev.kind, ev.kv.key, ev.kv.value) == ("put", b"a", b"mine")
+            w.cancel()
+            # replay obeys the same single-key range
+            w2 = await cli.watch("a", start_revision=1)
+            ev2 = await w2.__anext__()
+            assert ev2.kv.key == b"a"
+            w2.cancel()
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_etcd_watch_from_compaction_boundary_and_history_bound():
+    """compact(R) keeps revision R watchable (etcd only discards
+    strictly-below); the history buffer auto-compacts at its bound
+    instead of growing without limit."""
+
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await etcd.SimServer(history_limit=8).serve("0.0.0.0:2379")
+
+        handle.create_node().name("etcd").ip("10.6.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.6.0.2").build()
+
+        async def go():
+            cli = await etcd.Client.connect("10.6.0.1:2379")
+            await cli.put("k/a", "1")
+            rev_b = (await cli.put("k/b", "2"))["revision"]
+            await cli.compact(rev_b)
+            # the boundary revision itself replays fine
+            w = await cli.watch("k/", prefix=True, start_revision=rev_b)
+            ev = await w.__anext__()
+            assert (ev.kind, ev.kv.key) == ("put", b"k/b")
+            w.cancel()
+            # strictly below is gone
+            try:
+                await cli.watch("k/", prefix=True, start_revision=rev_b - 1)
+                raise AssertionError("expected ErrCompacted")
+            except etcd.EtcdError as e:
+                assert "compacted" in str(e)
+
+            # write past the 8-event bound: old revisions auto-compact
+            first = (await cli.put("k/c", "0"))["revision"]
+            for i in range(12):
+                await cli.put("k/c", str(i))
+            try:
+                await cli.watch("k/", prefix=True, start_revision=first)
+                raise AssertionError("expected ErrCompacted from auto-compaction")
+            except etcd.EtcdError as e:
+                assert "compacted" in str(e)
+            # recent history still replays
+            status = await cli.status()
+            w2 = await cli.watch("k/", prefix=True,
+                                 start_revision=status["revision"])
+            ev2 = await w2.__anext__()
+            assert ev2.kv.value == b"11"
+            w2.cancel()
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
 def test_etcd_timeout_rate_injection():
     async def main():
         handle = Handle.current()
@@ -734,6 +919,105 @@ def test_kafka_group_zombie_commit_fenced():
     assert run(main)
 
 
+def test_kafka_evicted_member_resumes_from_committed_not_stale_position():
+    """An evicted member that rejoins must resume re-acquired partitions
+    from the group's committed offsets, not its stale in-memory
+    positions (review finding: the stale position re-consumed and then
+    REWOUND the group's committed offset past another member's work)."""
+
+    async def main():
+        handle = Handle.current()
+        _kafka_broker(handle)
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.7.0.2").build()
+
+        async def go():
+            cfg = kafka.ClientConfig({"bootstrap.servers": "10.7.0.1:9092"})
+            admin = await cfg.create_admin()
+            await admin.create_topics([kafka.NewTopic("t", 2)])
+            gcfg = kafka.ClientConfig(
+                {"bootstrap.servers": "10.7.0.1:9092", "group.id": "g",
+                 "session.timeout.ms": "500", "heartbeat.interval.ms": "100"}
+            )
+            c1 = await gcfg.create_base_consumer()
+            await c1.subscribe(["t"])
+            c2 = await gcfg.create_base_consumer()
+            await c2.subscribe(["t"])
+            await c1.poll(0.3)  # settle: one partition each
+
+            prod = await cfg.create_base_producer()
+            for i in range(10):
+                prod.send(kafka.BaseRecord("t", payload=b"m%d" % i, partition=i % 2))
+            await prod.flush()
+
+            seen = []
+            # c2 consumes a little, then goes silent (will be evicted)
+            for _ in range(2):
+                m = await c2.poll(0.1)
+                if m is not None:
+                    seen.append(m.payload)
+            # c1 outlives the session timeout, absorbs both partitions,
+            # consumes and auto-commits everything
+            for _ in range(40):
+                m = await c1.poll(0.1)
+                if m is not None:
+                    seen.append(m.payload)
+                if len(seen) >= 10:
+                    break
+            assert len((await admin.describe_group("g"))["members"]) == 1
+
+            # c2 returns: evicted -> rejoin -> must NOT re-consume
+            for _ in range(10):
+                m = await c2.poll(0.1)
+                if m is not None:
+                    seen.append(m.payload)
+            assert sorted(seen) == sorted(b"m%d" % i for i in range(10)), seen
+            # committed offsets were never rewound
+            assert await c1.committed("t", 0) == 5
+            assert await c1.committed("t", 1) == 5
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_kafka_roundrobin_interleaves_across_topics():
+    """Kafka's RoundRobinAssignor does one circular pass over ALL
+    topic-partitions: three 1-partition topics over two members split
+    2/1, not 3/0 (review finding: per-topic restart starved member 2)."""
+
+    async def main():
+        handle = Handle.current()
+        _kafka_broker(handle)
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.7.0.2").build()
+
+        async def go():
+            cfg = kafka.ClientConfig({"bootstrap.servers": "10.7.0.1:9092"})
+            admin = await cfg.create_admin()
+            await admin.create_topics(
+                [kafka.NewTopic("a", 1), kafka.NewTopic("b", 1), kafka.NewTopic("c", 1)]
+            )
+            gcfg = kafka.ClientConfig(
+                {"bootstrap.servers": "10.7.0.1:9092", "group.id": "g",
+                 "heartbeat.interval.ms": "100",
+                 "partition.assignment.strategy": "roundrobin"}
+            )
+            c1 = await gcfg.create_base_consumer()
+            await c1.subscribe(["a", "b", "c"])
+            c2 = await gcfg.create_base_consumer()
+            await c2.subscribe(["a", "b", "c"])
+            await c1.poll(0.3)
+            desc = await admin.describe_group("g")
+            assert sorted(len(a) for a in desc["assignments"].values()) == [1, 2], desc
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
 def test_kafka_group_roundrobin_strategy():
     """partition.assignment.strategy=roundrobin interleaves partitions
     across members instead of range's contiguous chunks."""
@@ -764,6 +1048,60 @@ def test_kafka_group_roundrobin_strategy():
                 sorted(p for _t, p in parts) for parts in desc["assignments"].values()
             )
             assert by_member == [[0, 2], [1]]
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_s3_lifecycle_expiration_enforced():
+    """Lifecycle rules actually expire objects and abort stale multipart
+    uploads as virtual time passes (the background job a real S3 runs
+    daily — config was previously stored but never enforced)."""
+
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await s3.SimServer(lifecycle_interval=3600.0).serve("0.0.0.0:9000")
+
+        handle.create_node().name("s3").ip("10.8.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.8.0.2").build()
+
+        async def go():
+            cli = s3.Client.from_conf(s3.Config(endpoint_url="http://10.8.0.1:9000"))
+            await cli.create_bucket().bucket("b").send()
+            await cli.put_bucket_lifecycle_configuration().bucket("b").config(
+                {"rules": [
+                    {"id": "tmp", "prefix": "tmp/", "days": 1},
+                    {"id": "mp", "prefix": "up/", "abort_multipart_days": 1},
+                ]}
+            ).send()
+            await cli.put_object().bucket("b").key("tmp/x").body(b"1").send()
+            await cli.put_object().bucket("b").key("keep/y").body(b"2").send()
+            up = await cli.create_multipart_upload().bucket("b").key("up/z").send()
+
+            # a day later the tmp/ object is still short of the 1-day age
+            await sim_time.sleep(0.5 * 86400)
+            assert (await cli.get_object().bucket("b").key("tmp/x").send())["body"] == b"1"
+
+            await sim_time.sleep(1.5 * 86400 + 3600)
+            try:
+                await cli.get_object().bucket("b").key("tmp/x").send()
+                raise AssertionError("tmp/x must be expired")
+            except s3.S3Error as e:
+                assert e.code == "NoSuchKey"
+            # unscoped keys survive
+            got = await cli.get_object().bucket("b").key("keep/y").send()
+            assert got["body"] == b"2"
+            # stale multipart upload was aborted
+            try:
+                await cli.upload_part().upload_id(up["upload_id"]).part_number(1).body(b"p").send()
+                raise AssertionError("upload must be aborted")
+            except s3.S3Error as e:
+                assert e.code == "NoSuchUpload"
             return True
 
         return await c.spawn(go())
