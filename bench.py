@@ -38,7 +38,9 @@ def main() -> None:
     from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
     from madsim_tpu.models.raft import RaftMachine
 
-    lanes = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    # default = the real-chip sweep's max (benches/tpu_sweep.py, r2:
+    # 8192x384 -> 2825 seeds/s vs 2214 at the old 4096x192)
+    lanes = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
     cfg = EngineConfig(
         horizon_us=5_000_000,
         queue_capacity=96,
@@ -47,12 +49,12 @@ def main() -> None:
     eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
 
     # warmup / compile the streaming path at the timed batch size
-    eng.run_stream(1, batch=lanes, segment_steps=192)
+    eng.run_stream(1, batch=lanes, segment_steps=384)
 
     # timed: seed streaming keeps every lane busy (finished lanes refill
     # with fresh seeds each segment, so stragglers never idle the batch)
     t0 = time.perf_counter()
-    out = eng.run_stream(3 * lanes, batch=lanes, segment_steps=192, seed_start=1_000_000)
+    out = eng.run_stream(3 * lanes, batch=lanes, segment_steps=384, seed_start=1_000_000)
     elapsed = time.perf_counter() - t0
     total = out["completed"]
 

@@ -1,0 +1,71 @@
+"""Parameter sweep for the TPU-engine streaming path on a live chip.
+
+Sweeps batch (lanes) x segment_steps on the flagship MadRaft bench
+workload and prints one JSON line per point. Run:
+
+    python benches/tpu_sweep.py                # default grid
+    python benches/tpu_sweep.py 8192 192       # single point
+    MADSIM_TPU_PALLAS_POP=1 python benches/tpu_sweep.py 8192 192
+
+The timed region matches bench.py (3*batch seeds streamed, warmed up).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from madsim_tpu._backend_watchdog import ensure_live_backend
+
+ensure_live_backend()
+
+import jax  # noqa: E402
+
+
+def run_point(batch: int, segment_steps: int) -> dict:
+    from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
+    from madsim_tpu.models.raft import RaftMachine
+
+    cfg = EngineConfig(
+        horizon_us=5_000_000,
+        queue_capacity=96,
+        faults=FaultPlan(n_faults=2, t_max_us=3_000_000, dur_min_us=200_000, dur_max_us=800_000),
+    )
+    eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
+    t_c0 = time.perf_counter()
+    eng.run_stream(1, batch=batch, segment_steps=segment_steps)
+    compile_s = time.perf_counter() - t_c0
+    t0 = time.perf_counter()
+    out = eng.run_stream(3 * batch, batch=batch, segment_steps=segment_steps, seed_start=1_000_000)
+    elapsed = time.perf_counter() - t0
+    return {
+        "batch": batch,
+        "segment_steps": segment_steps,
+        "pallas_pop": os.environ.get("MADSIM_TPU_PALLAS_POP", "0"),
+        "seeds_per_sec": round(out["completed"] / elapsed, 1),
+        "completed": out["completed"],
+        "elapsed_s": round(elapsed, 2),
+        "compile_s": round(compile_s, 1),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main() -> None:
+    if len(sys.argv) >= 3:
+        grid = [(int(sys.argv[1]), int(sys.argv[2]))]
+    else:
+        grid = [
+            (4096, 192),
+            (8192, 192),
+            (16384, 192),
+            (32768, 192),
+            (8192, 384),
+            (16384, 384),
+        ]
+    for batch, seg in grid:
+        print(json.dumps(run_point(batch, seg)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

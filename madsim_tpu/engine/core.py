@@ -34,7 +34,10 @@ import jax.numpy as jnp
 from flax import struct
 from jax import lax
 
+import os
+
 from ..ops import find_free_slot, pop_earliest
+from ..ops.pallas_pop import pop_earliest_batch
 from ..utils import set2d, tree_where
 from .machine import BOOT, Machine, Outbox
 
@@ -153,6 +156,11 @@ class Engine:
     def __init__(self, machine: Machine, config: EngineConfig = EngineConfig()):
         self.machine = machine
         self.config = config
+        # Batched event-pop backend: the fused Pallas kernel
+        # (ops/pallas_pop.py) vs the vmapped XLA reductions. Opt-in via
+        # env because pallas_call blocks sharding propagation on meshed
+        # runs; read once at construction so jit caches stay consistent.
+        self.use_pallas_pop = os.environ.get("MADSIM_TPU_PALLAS_POP", "") not in ("", "0")
         n, q = machine.NUM_NODES, config.queue_capacity
         min_slots = n + 2 * config.faults.n_faults
         if q < min_slots + machine.MAX_MSGS + machine.MAX_TIMERS:
@@ -255,9 +263,15 @@ class Engine:
     # -- one event per lane --------------------------------------------------
 
     def lane_step(self, s: LaneState) -> LaneState:
+        idx, any_valid = pop_earliest(s.eq_time, s.eq_seq, s.eq_valid)
+        return self._lane_step_popped(s, idx, any_valid)
+
+    def _lane_step_popped(self, s: LaneState, idx, any_valid) -> LaneState:
+        """lane_step with the event-queue pop hoisted out, so step_batch
+        can swap in the batched Pallas pop kernel for the whole [L, Q]
+        block while the rest of the step stays vmapped."""
         m, cfg = self.machine, self.config
 
-        idx, any_valid = pop_earliest(s.eq_time, s.eq_seq, s.eq_valid)
         ev_time = s.eq_time[idx]
         ev_kind = s.eq_kind[idx]
         ev_node = s.eq_node[idx]
@@ -440,7 +454,10 @@ class Engine:
         return jax.vmap(self.init_lane)(seeds)
 
     def step_batch(self, state: LaneState) -> LaneState:
-        new = jax.vmap(self.lane_step)(state)
+        idx, any_valid = pop_earliest_batch(
+            state.eq_time, state.eq_seq, state.eq_valid, use_pallas=self.use_pallas_pop
+        )
+        new = jax.vmap(self._lane_step_popped)(state, idx, any_valid)
         active = ~(state.done | state.failed)
         return tree_where(active, new, state)
 

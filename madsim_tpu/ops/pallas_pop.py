@@ -52,9 +52,11 @@ def _pop_kernel(time_ref, seq_ref, valid_ref, idx_ref, any_ref):
     # argmin = smallest column index among exact (tmin, smin) matches
     cols = jax.lax.broadcasted_iota(jnp.int32, t.shape, dimension=t.ndim - 1)
     idx_enc = jnp.where(tie & (s == smin), cols, jnp.int32(q))
-    idx = jnp.min(idx_enc, axis=-1)
+    idx = jnp.min(idx_enc, axis=-1, keepdims=True)
+    # outputs are [LANE_BLOCK, 1]: Mosaic requires rank-1 block shapes to
+    # be 128-multiples, so the lane-per-row result keeps a unit minor dim
     idx_ref[...] = jnp.where(idx == q, 0, idx)
-    any_ref[...] = jnp.any(v, axis=-1).astype(jnp.int32)
+    any_ref[...] = jnp.any(v, axis=-1, keepdims=True).astype(jnp.int32)
 
 
 def pop_earliest_pallas(eq_time, eq_seq, eq_valid, interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
@@ -74,19 +76,19 @@ def pop_earliest_pallas(eq_time, eq_seq, eq_valid, interpret: bool = False) -> T
     padded = lanes + pad
     grid = (padded // LANE_BLOCK,)
     row_spec = pl.BlockSpec((LANE_BLOCK, q), lambda i: (i, 0))
-    out_spec = pl.BlockSpec((LANE_BLOCK,), lambda i: (i,))
+    out_spec = pl.BlockSpec((LANE_BLOCK, 1), lambda i: (i, 0))
     idx, any_valid = pl.pallas_call(
         _pop_kernel,
         grid=grid,
         in_specs=[row_spec, row_spec, row_spec],
         out_specs=[out_spec, out_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((padded,), jnp.int32),
-            jax.ShapeDtypeStruct((padded,), jnp.int32),
+            jax.ShapeDtypeStruct((padded, 1), jnp.int32),
+            jax.ShapeDtypeStruct((padded, 1), jnp.int32),
         ],
         interpret=interpret,
     )(eq_time, eq_seq, eq_valid.astype(jnp.int32))
-    return idx[:lanes], any_valid[:lanes] != 0
+    return idx[:lanes, 0], any_valid[:lanes, 0] != 0
 
 
 def pop_earliest_batch(eq_time, eq_seq, eq_valid, use_pallas: bool = False, interpret: bool = False):

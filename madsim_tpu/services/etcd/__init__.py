@@ -230,6 +230,11 @@ class SimServer:
         entry_box: list = [None]
 
         def emit(ev: Event) -> None:
+            # a future start_revision is a resume point: hold the watch
+            # and deliver nothing below it (real etcd parks the watcher
+            # until the store revision catches up)
+            if start_rev and ev.kv.mod_revision < start_rev:
+                return
             if ev.kind == Event.PUT and "noput" in filters:
                 return
             if ev.kind == Event.DELETE and "nodelete" in filters:
@@ -272,8 +277,11 @@ class SimServer:
         # requests in the meantime
         while (req := await rx.recv()) is not None:
             if req and req[0] == "progress_req":
+                # distinct tag: an on-demand reply must reflect the
+                # revision at request-processing time, so the client must
+                # not satisfy it with a stale queued periodic notification
                 try:
-                    tx.send(("progress", svc.revision))
+                    tx.send(("progress_resp", svc.revision))
                 except ConnectionReset:
                     break
         stop[0] = True
@@ -341,7 +349,7 @@ class Watcher:
             msg = self._pending.pop(0) if self._pending else await self._rx.recv()
             if msg is None:
                 raise StopAsyncIteration
-            if msg[0] == "progress":
+            if msg[0] in ("progress", "progress_resp"):
                 self.progress_revision = msg[1]
                 continue
             return msg[1]
@@ -349,13 +357,18 @@ class Watcher:
     async def progress(self) -> int:
         """Request + await a progress notification (reference class:
         etcd WatchProgressRequest); events arriving in between are
-        buffered for the next `__anext__`."""
+        buffered for the next `__anext__`. Only the tagged on-demand
+        reply resolves the call — a stale queued periodic notification
+        must not masquerade as "synced through the current revision"."""
         self._tx.send(("progress_req",))
         while True:
             msg = await self._rx.recv()
             if msg is None:
                 raise EtcdError("watch stream closed")
             if msg[0] == "progress":
+                self.progress_revision = msg[1]
+                continue
+            if msg[0] == "progress_resp":
                 self.progress_revision = msg[1]
                 return msg[1]
             self._pending.append(msg)

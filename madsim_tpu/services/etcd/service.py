@@ -84,7 +84,15 @@ class EtcdService:
         # compaction, like etcd's MVCC keyspace history); deque so the
         # steady-state trim is O(1) per write, not a list rebuild
         self.history: "deque[Tuple[int, Event]]" = deque()
+        # compact_revision: the revision a client last compacted at
+        # (etcd's compactMainRev — compact() below it is ErrCompacted).
+        # history_floor: the lowest revision whose events are still
+        # replayable for watch(start_revision) — raised by compaction,
+        # by the bounded-history trim, and by load() (which has no
+        # history at all). Kept separate so a load at revision R doesn't
+        # make compact(R) impossible (see load()).
         self.compact_revision = 0
+        self.history_floor = 0
 
     # -- helpers --------------------------------------------------------------
 
@@ -113,7 +121,7 @@ class EtcdService:
                 boundary = self.history.popleft()[0]
             while self.history and self.history[0][0] == boundary:
                 self.history.popleft()
-            self.compact_revision = max(self.compact_revision, boundary + 1)
+            self.history_floor = max(self.history_floor, boundary + 1)
         for lo, hi, cb in list(self.watchers):
             if self._in_range(ev.kv.key, lo, hi):
                 cb(ev)
@@ -123,7 +131,7 @@ class EtcdService:
         Raises if the range was compacted away (etcd: ErrCompacted —
         only revisions strictly BELOW the compaction point are gone;
         compact(R) retains the events at R itself)."""
-        if start_revision < self.compact_revision:
+        if start_revision < max(self.history_floor, self.compact_revision):
             raise EtcdError("etcdserver: mvcc: required revision has been compacted")
         return [
             ev for rev, ev in self.history
@@ -138,6 +146,7 @@ class EtcdService:
         if revision <= self.compact_revision:
             raise EtcdError("etcdserver: mvcc: required revision has been compacted")
         self.compact_revision = revision
+        self.history_floor = max(self.history_floor, revision)
         self.history = deque((r, e) for r, e in self.history if r >= revision)
         return {"revision": self.revision, "compact_revision": revision}
 
@@ -366,6 +375,7 @@ class EtcdService:
         return json.dumps(
             {
                 "revision": self.revision,
+                "compact_revision": self.compact_revision,
                 "kv": [kv.to_dict() for kv in self.kv.values()],
                 "leases": {str(k): v for k, v in self.leases.items()},
                 "lease_keys": {str(k): sorted(x.decode("latin1") for x in v) for k, v in self.lease_keys.items()},
@@ -378,12 +388,15 @@ class EtcdService:
         data = json.loads(text)
         self.revision = data["revision"]
         # loaded state has no event history: watchers cannot replay
-        # revisions up to and including the load point (compaction at R
-        # retains R, so the boundary must sit one past the last missing
-        # revision or a start_revision==revision watch would silently
-        # skip that revision's events)
+        # revisions up to and including the load point (the floor sits
+        # one past the last missing revision or a
+        # start_revision==revision watch would silently skip that
+        # revision's events). compact_revision stays at its dumped
+        # value so compact(current revision) still works after a
+        # restore, like real etcd.
         self.history = deque()
-        self.compact_revision = self.revision + 1
+        self.history_floor = self.revision + 1
+        self.compact_revision = data.get("compact_revision", 0)
         self.kv = {}
         for d in data["kv"]:
             kv = KeyValue.from_dict(d)

@@ -260,6 +260,122 @@ def test_etcd_watch_progress_notify():
     assert run(main)
 
 
+def test_etcd_progress_not_satisfied_by_stale_notification():
+    """On-demand progress() must reflect the revision at request time —
+    a queued periodic notification from before a later put must not
+    resolve it (review finding: the client consumed whatever "progress"
+    message arrived first, under-reporting the synced revision)."""
+
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await etcd.SimServer(progress_interval=0.5).serve("0.0.0.0:2379")
+
+        handle.create_node().name("etcd").ip("10.6.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.6.0.2").build()
+
+        async def go():
+            cli = await etcd.Client.connect("10.6.0.1:2379")
+            w = await cli.watch("w/", prefix=True, progress_notify=True)
+            # let a periodic notification land in the client queue ...
+            await sim_time.sleep(1.0)
+            # ... then advance the keyspace and immediately ask
+            rev_after_put = (await cli.put("y", "2"))["revision"]
+            rev = await w.progress()
+            assert rev >= rev_after_put
+            # events arriving while progress() awaited are buffered, not
+            # dropped: this put races the progress round trip
+            await cli.put("w/k", "v")
+            rev2 = await w.progress()
+            assert rev2 > rev
+            ev = await w.__anext__()
+            assert (ev.kind, ev.kv.key) == ("put", b"w/k")
+            w.cancel()
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_etcd_watch_future_start_revision_holds():
+    """A start_revision ahead of the store is a resume point: the watch
+    delivers nothing until the store reaches it, then only events at
+    >= start_revision (review finding: live events below the requested
+    revision leaked through)."""
+
+    async def main():
+        handle = Handle.current()
+        await _etcd_node(handle)
+        c = handle.create_node().ip("10.6.0.2").build()
+
+        async def go():
+            cli = await etcd.Client.connect("10.6.0.1:2379")
+            cur = (await cli.put("w/a", "1"))["revision"]
+            w = await cli.watch("w/", prefix=True, start_revision=cur + 3)
+            await cli.put("w/skip1", "x")   # cur+1: below -> withheld
+            await cli.put("w/skip2", "y")   # cur+2: below -> withheld
+            await cli.put("w/take", "z")    # cur+3: delivered
+            ev = await w.__anext__()
+            assert (ev.kind, ev.kv.key) == ("put", b"w/take")
+            assert ev.kv.mod_revision == cur + 3
+            w.cancel()
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
+def test_etcd_compact_at_current_revision_after_load():
+    """dump/load then compact(current revision) must succeed (review
+    finding: load() reused the compaction boundary as the replay floor,
+    so every legal compact() errored until two more writes happened).
+    Watch replay through the load point still raises ErrCompacted."""
+
+    async def main():
+        handle = Handle.current()
+        await _etcd_node(handle)
+        c = handle.create_node().ip("10.6.0.2").build()
+
+        async def go():
+            cli = await etcd.Client.connect("10.6.0.1:2379")
+            await cli.put("k", "1")
+            rev = (await cli.put("k", "2"))["revision"]
+            snap = await cli.dump()
+            await cli.load(snap)
+            # the standard periodic "compact at current revision" pattern
+            out = await cli.compact(rev)
+            assert out["compact_revision"] == rev
+            # a second compact at the same point is ErrCompacted, ahead
+            # of the store is a future revision — etcd's error taxonomy
+            for bad in (rev, rev + 1):
+                try:
+                    await cli.compact(bad)
+                    raise AssertionError("expected EtcdError")
+                except etcd.EtcdError as e:
+                    assert "compacted" in str(e) or "future" in str(e)
+            # replay across the load gap is refused ...
+            try:
+                await cli.watch("k", start_revision=rev)
+                raise AssertionError("expected ErrCompacted")
+            except etcd.EtcdError as e:
+                assert "compacted" in str(e)
+            # ... but live watching resumes fine
+            w = await cli.watch("k")
+            await cli.put("k", "3")
+            ev = await w.__anext__()
+            assert ev.kv.value == b"3"
+            w.cancel()
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
+
+
 def test_etcd_single_key_watch_is_single_key():
     """watch(key) without prefix must deliver only that key's events
     (review finding: the watcher treated range_end=b"" as unbounded and
