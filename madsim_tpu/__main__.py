@@ -24,9 +24,11 @@ def _build_engine(args):
     from .engine import Engine, EngineConfig, FaultPlan
     from .models.echo import EchoMachine
     from .models.etcd import EtcdMachine
+    from .models.kafka_group import KafkaGroupMachine
     from .models.kv import KvMachine
     from .models.mq import MqMachine
     from .models.raft import RaftMachine
+    from .models.twopc import TwoPcMachine
 
     machines = {
         "echo": lambda: EchoMachine(rounds=10),
@@ -34,6 +36,8 @@ def _build_engine(args):
         "kv": lambda: KvMachine(num_nodes=args.nodes or 4),
         "mq": lambda: MqMachine(num_nodes=args.nodes or 4),
         "etcd": lambda: EtcdMachine(num_nodes=args.nodes or 4),
+        "twopc": lambda: TwoPcMachine(num_nodes=args.nodes or 4),
+        "group": lambda: KafkaGroupMachine(num_nodes=args.nodes or 4),
     }
     if args.machine not in machines:
         sys.exit(f"unknown machine {args.machine!r}; choose from {sorted(machines)}")
@@ -57,6 +61,31 @@ def _build_engine(args):
 
 def cmd_explore(args) -> int:
     import jax.numpy as jnp
+
+    if getattr(args, "multihost", False):
+        # join the jax.distributed job (MADSIM_TPU_COORDINATOR/NUM_PROCS/
+        # PROC_ID, or pod auto-detect) and shard the batch globally
+        from .parallel import multihost, pad_to_multiple
+
+        multihost.initialize()
+        import jax as _jax
+
+        eng = _build_engine(args)
+        n = pad_to_multiple(args.seeds, _jax.device_count())
+        out = multihost.run_batch_global(
+            eng, n, seed_start=args.seed, max_steps=args.max_steps
+        )
+        # results are replicated on every process — only rank 0 reports
+        if _jax.process_index() == 0:
+            print(
+                f"explored {n} seeds over {out['processes']} processes / "
+                f"{out['global_devices']} devices ({out['completed']} completed), "
+                f"{out['failed']} failing"
+            )
+            if out["failing"]:
+                print(f"failing seeds: {out['failing'][:20]}"
+                      f"{' ...' if out['truncated'] else ''}")
+        return 1 if out["failing"] else 0
 
     eng = _build_engine(args)
     seeds = jnp.arange(args.seed, args.seed + args.seeds, dtype=jnp.uint32)
@@ -208,6 +237,11 @@ def main(argv=None) -> int:
     p = sub.add_parser("explore", help="run a seed batch, report failing seeds")
     common(p)
     p.add_argument("--seeds", type=int, default=1024)
+    p.add_argument(
+        "--multihost", action="store_true",
+        help="shard the batch over a jax.distributed job "
+             "(MADSIM_TPU_COORDINATOR/NUM_PROCS/PROC_ID env vars)",
+    )
     p.set_defaults(fn=cmd_explore)
 
     p = sub.add_parser("replay", help="bit-identical replay of one seed with trace")
@@ -238,7 +272,14 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)
-    if args.cmd != "serve":  # serve never touches jax — skip the probe
+    if getattr(args, "multihost", False):
+        # distributed init must precede ANY backend access — including
+        # the watchdog's own device probe, which would pin a
+        # single-process backend
+        from .parallel import multihost
+
+        multihost.initialize()
+    elif args.cmd != "serve":  # serve never touches jax — skip the probe
         from ._backend_watchdog import ensure_live_backend
 
         cli_args = list(argv) if argv is not None else sys.argv[1:]

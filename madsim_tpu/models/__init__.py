@@ -13,8 +13,11 @@ MVCC server), lease-safety invariant (the madsim-etcd-client service-
 class workload, batched).
 `twopc` — two-phase commit with durable write-ahead logs, transaction-
 atomicity invariant (the atomic-commitment workload class).
+`kafka_group` — consumer-group coordinator with generations, session
+timeouts and fenced commits; at-least-once + no-commit-regression
+invariants (the rdkafka consumer-group workload, batched).
 """
 
-from . import echo, etcd, kv, mq, raft, twopc
+from . import echo, etcd, kafka_group, kv, mq, raft, twopc
 
-__all__ = ["echo", "etcd", "kv", "mq", "raft", "twopc"]
+__all__ = ["echo", "etcd", "kafka_group", "kv", "mq", "raft", "twopc"]

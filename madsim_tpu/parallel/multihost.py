@@ -1,0 +1,143 @@
+"""Multi-host seed-batch scale-out over DCN (jax.distributed).
+
+The reference scales out with one OS thread per seed on one machine
+(madsim/src/sim/runtime/builder.rs:121-160) and TCP/UCX real-mode
+transports between machines (madsim/src/std/net/). The tpu-native
+equivalent (SURVEY.md §2.9/§5.8): every host joins one jax.distributed
+job, the seed-lane axis shards over the *global* device mesh (ICI within
+a slice, DCN across slices/hosts), and the engine's fused segment runs
+SPMD — each process computes only its lane shard, and only replicated
+reductions (completed counts, the fixed-capacity failing-seed ring)
+cross hosts.
+
+Smoke-tested without TPU pods by running N processes on one machine with
+virtual CPU devices (tests/test_multihost.py: 2 processes x 4 devices,
+Gloo collectives) — the same code path a v5e multi-host job takes.
+
+Env-driven setup (mirrors the MADSIM_TEST_* harness style):
+  MADSIM_TPU_COORDINATOR  host:port of process 0
+  MADSIM_TPU_NUM_PROCS    total process count
+  MADSIM_TPU_PROC_ID      this process's id
+On managed TPU pods (GKE/queued resources), call `initialize()` with no
+arguments — jax auto-detects the cluster.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import SEED_AXIS, make_mesh, seed_sharding
+
+_ENV_COORD = "MADSIM_TPU_COORDINATOR"
+_ENV_NPROCS = "MADSIM_TPU_NUM_PROCS"
+_ENV_PID = "MADSIM_TPU_PROC_ID"
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join (or start) the distributed job. Idempotent. Arguments fall
+    back to MADSIM_TPU_* env vars, then to jax's cluster auto-detection
+    (TPU pod metadata)."""
+    if getattr(initialize, "_done", False):
+        return
+    coordinator_address = coordinator_address or os.environ.get(_ENV_COORD)
+    if num_processes is None and os.environ.get(_ENV_NPROCS):
+        num_processes = int(os.environ[_ENV_NPROCS])
+    if process_id is None and os.environ.get(_ENV_PID):
+        process_id = int(os.environ[_ENV_PID])
+    try:
+        # NOTE: must run before anything touches the XLA backend —
+        # including jax.devices()/process_count(), so no jax-based
+        # "already initialized" probe is possible here
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "already" not in str(e).lower():
+            raise
+    initialize._done = True  # type: ignore[attr-defined]
+
+
+def global_mesh():
+    """1-D "seeds" mesh over every device in the job (all hosts)."""
+    return make_mesh(jax.devices())
+
+
+def global_seeds(n_seeds: int, seed_start: int = 0, mesh=None) -> jax.Array:
+    """uint32 [seed_start, seed_start+n) sharded over the global mesh.
+    Each process materializes only its local shard."""
+    mesh = mesh if mesh is not None else global_mesh()
+    axis = mesh.shape[SEED_AXIS]
+    if n_seeds % axis != 0:
+        raise ValueError(f"n_seeds ({n_seeds}) must be a multiple of the global device count ({axis})")
+
+    def local_shard(index):
+        return np.arange(seed_start, seed_start + n_seeds, dtype=np.uint32)[index]
+
+    return jax.make_array_from_callback((n_seeds,), seed_sharding(mesh), local_shard)
+
+
+def run_batch_global(
+    engine,
+    n_seeds: int,
+    seed_start: int = 0,
+    max_steps: int = 10_000,
+    fail_capacity: int = 1024,
+    mesh=None,
+) -> dict:
+    """Run a globally-sharded seed batch SPMD across every host and
+    return host-local results: completion/failure counts plus up to
+    `fail_capacity` failing (seed, code) pairs, identical on every
+    process (replicated reductions — the only cross-host traffic).
+    """
+    mesh = mesh if mesh is not None else global_mesh()
+    seeds = global_seeds(n_seeds, seed_start, mesh)
+    res = jax.jit(partial(engine.run_batch, max_steps=max_steps))(seeds)
+
+    replicated = NamedSharding(mesh, P())
+
+    @partial(jax.jit, out_shardings=replicated)
+    def stats(r):
+        mask = r.failed
+        csum = jnp.cumsum(mask.astype(jnp.int32))
+        n_fail = csum[-1] if mask.shape[0] else jnp.int32(0)
+        want = jnp.arange(fail_capacity, dtype=jnp.int32) + 1
+        src = jnp.clip(
+            jnp.searchsorted(csum, want, side="left").astype(jnp.int32),
+            0,
+            max(mask.shape[0] - 1, 0),
+        )
+        fill = want <= n_fail
+        return {
+            "completed": r.done.sum(dtype=jnp.int32),
+            "failed": n_fail,
+            "fail_seeds": jnp.where(fill, r.seeds[src], 0),
+            "fail_codes": jnp.where(fill, r.fail_code[src], 0),
+        }
+
+    out = jax.device_get(stats(res))
+    n_fail = int(out["failed"])
+    listed = min(n_fail, fail_capacity)
+    return {
+        "completed": int(out["completed"]),
+        "failed": n_fail,
+        "failing": [
+            (int(s), int(c))
+            for s, c in zip(out["fail_seeds"][:listed], out["fail_codes"][:listed])
+        ],
+        "truncated": n_fail > fail_capacity,
+        "processes": jax.process_count(),
+        "global_devices": jax.device_count(),
+    }

@@ -318,31 +318,53 @@ class _Race(Pollable):
             p.drop()
 
 
+class _InlineFuture(Pollable):
+    """Drive an arbitrary awaitable inline within the *current* task.
+
+    This is how the reference's `timeout` works (sim/time/mod.rs:125-140
+    `select_biased!` polls the future in place): no helper task, inner
+    panics propagate to the caller, and dropping on expiry cancels the
+    whole future tree via GeneratorExit. It also removes a task spawn
+    from every `call_timeout` — the RPC hot path.
+    """
+
+    __slots__ = ("_it", "_step")
+
+    def __init__(self, aw):
+        # coroutines drive directly; other awaitables via __await__()
+        self._it = aw if hasattr(aw, "send") else aw.__await__()
+        # coroutines are not iterators (no __next__) — step via send;
+        # plain __await__ iterators (e.g. the native AwaitIter) via next
+        send = getattr(self._it, "send", None)
+        self._step = (lambda: send(None)) if send is not None else self._it.__next__
+
+    def poll(self, waker):
+        # the inner awaitable's own leaf pollables register the current
+        # task's waker (re-poll-on-wake contract makes that sound)
+        try:
+            self._step()
+        except StopIteration as e:
+            return Ready(e.value)
+        return PENDING
+
+    def drop(self) -> None:
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+
+
 async def timeout(duration: Union[int, float], fut: Union[Pollable, Awaitable]) -> Any:
     """Await `fut` for at most `duration` virtual seconds.
 
     Raises built-in `TimeoutError` on expiry (reference `timeout` returns
-    `Err(Elapsed)`; sim/time/mod.rs:125-140 `select_biased`). A coroutine
-    argument is spawned as a task and aborted on expiry.
+    `Err(Elapsed)`; sim/time/mod.rs:125-140 `select_biased`). The future
+    is polled inline — expiry or surrounding cancellation drops it,
+    cascading through nested timeouts (reference/tokio drop semantics).
     """
-    from ..task import spawn  # local import: task depends on time
-
     th = _context.current_time()
     deadline = _sleep_pollable(th, th.now_ns() + to_ns(duration))
-    if isinstance(fut, Pollable):
-        idx, value = await await_(_Race([fut, deadline]))
-        if idx == 0:
-            return value
-        raise TimeoutError(f"timed out after {duration}s (virtual)")
-    handle = spawn(fut)
-    try:
-        idx, value = await await_(_Race([handle, deadline]))
-    finally:
-        # Expiry, surrounding cancellation, or inner panic all abort the
-        # helper task, cascading like dropping a future tree (nested
-        # timeouts cancel their children; reference/tokio drop semantics).
-        if not handle.is_finished():
-            handle.abort()
+    inner = fut if isinstance(fut, Pollable) else _InlineFuture(fut)
+    idx, value = await await_(_Race([inner, deadline]))
     if idx == 0:
         return value
     raise TimeoutError(f"timed out after {duration}s (virtual)")

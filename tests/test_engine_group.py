@@ -1,0 +1,120 @@
+"""Consumer-group workload on the TPU engine: the coordinator machine
+(models/kafka_group.py) batched over seeds with chaos.
+
+Mirrors the consumer-group scenario family of the reference's kafka
+integration tests (/root/reference/madsim-rdkafka/tests/test.rs) and the
+host-side group tests in tests/test_services.py, but batched: thousands
+of seeds explore member kill/restart and network partitions against the
+coordinator, and the fencing bug variant is caught by the on-device
+invariant with bit-identical host replay.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from madsim_tpu.engine import Engine, EngineConfig, FaultPlan, replay
+from madsim_tpu.models.kafka_group import (
+    COMMIT_REGRESS,
+    KafkaGroupMachine,
+    NoFencingGroupMachine,
+)
+
+
+def _cfg(**kw):
+    defaults = dict(
+        horizon_us=8_000_000,
+        queue_capacity=96,
+        faults=FaultPlan(
+            n_faults=2, t_max_us=5_000_000, dur_min_us=200_000, dur_max_us=700_000
+        ),
+    )
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+def test_group_consumes_everything_without_faults():
+    eng = Engine(
+        KafkaGroupMachine(num_nodes=4, partitions=2, log_len=12),
+        _cfg(faults=FaultPlan(n_faults=0)),
+    )
+    res = eng.make_runner(max_steps=4000)(jnp.arange(48, dtype=jnp.uint32))
+    assert bool(res.done.all())
+    assert not bool(res.failed.any()), f"fail codes: {set(res.fail_code.tolist())}"
+    committed = res.summary["committed"]
+    # every lane drains both partitions to the end of the log
+    assert bool((committed >= 12).all()), committed[:8].tolist()
+    # exactly one rebalance per joining member (3 members -> gen 3)
+    assert set(res.summary["generation"].tolist()) == {3}
+
+
+def test_fenced_group_is_safe_under_chaos():
+    # faults land early (t <= 1.5s) so they hit lanes mid-consumption;
+    # cumulative same-generation commits absorb datagram reordering, so
+    # chaos must produce rebalances but never a regression or loss
+    eng = Engine(
+        KafkaGroupMachine(num_nodes=4, partitions=2, log_len=12),
+        _cfg(faults=FaultPlan(
+            n_faults=3, t_max_us=1_500_000, dur_min_us=250_000, dur_max_us=700_000
+        )),
+    )
+    res = eng.make_runner(max_steps=12000)(jnp.arange(96, dtype=jnp.uint32))
+    assert bool(res.done.all())
+    assert not bool(res.failed.any()), f"fail codes: {set(res.fail_code.tolist())}"
+    # chaos forces rebalances beyond the three joins on many lanes
+    gens = res.summary["generation"].tolist()
+    assert sum(1 for g in gens if g > 3) >= 20, f"too few rebalances: {gens[:16]}"
+    # progress is still made on every lane
+    committed = res.summary["committed"].sum(axis=1).tolist()
+    assert sum(1 for c in committed if c > 0) >= 90
+
+
+def test_unfenced_zombie_commits_flagged_and_replay(monkeypatch=None):
+    # partitions (not kills) create zombies: an expired-but-alive member
+    # keeps fetching/committing with its stale generation after the link
+    # heals; without fencing its commit regresses the committed offset
+    faults = FaultPlan(
+        n_faults=3, t_max_us=5_000_000, dur_min_us=200_000, dur_max_us=800_000,
+        allow_partition=True, allow_kill=False,
+    )
+    eng = Engine(
+        NoFencingGroupMachine(num_nodes=4, partitions=2, log_len=12),
+        _cfg(horizon_us=9_000_000, faults=faults),
+    )
+    out = eng.run_stream(256, batch=64, segment_steps=192, seed_start=500, max_steps=8000)
+    assert len(out["failing"]) > 0, "no zombie-commit seed found in 256"
+    assert all(code == COMMIT_REGRESS for _s, code in out["failing"])
+
+    # flagged seeds replay bit-identically on the single-lane host path
+    for seed, code in out["failing"][:2]:
+        rp = replay(eng, seed, max_steps=8000)
+        assert bool(rp.failed) and int(rp.fail_code) == code, f"seed {seed} no repro"
+
+
+def test_fencing_rejects_the_same_seeds():
+    # the exact seeds that fail unfenced pass with fencing on — the
+    # machine-level analogue of the host-side zombie-fence test
+    faults = FaultPlan(
+        n_faults=3, t_max_us=5_000_000, dur_min_us=200_000, dur_max_us=800_000,
+        allow_partition=True, allow_kill=False,
+    )
+    bad = Engine(
+        NoFencingGroupMachine(num_nodes=4, partitions=2, log_len=12),
+        _cfg(horizon_us=9_000_000, faults=faults),
+    )
+    out = bad.run_stream(128, batch=64, segment_steps=192, seed_start=500, max_steps=8000)
+    if not out["failing"]:
+        pytest.skip("no failing seed in the first 128 (covered by the test above)")
+    seeds = jnp.asarray([s for s, _ in out["failing"]], dtype=jnp.uint32)
+    good = Engine(
+        KafkaGroupMachine(num_nodes=4, partitions=2, log_len=12),
+        _cfg(horizon_us=9_000_000, faults=faults),
+    )
+    res = good.make_runner(max_steps=8000)(seeds)
+    assert not bool(res.failed.any()), (
+        f"fencing still failed seeds {res.seeds[res.failed].tolist()}"
+    )
+
+
+def test_group_determinism_across_traces():
+    eng = Engine(KafkaGroupMachine(num_nodes=4, partitions=2, log_len=12), _cfg())
+    eng.check_determinism(jnp.arange(16, dtype=jnp.uint32), max_steps=3000)
