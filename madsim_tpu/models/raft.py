@@ -72,6 +72,16 @@ class RaftMachine(Machine):
     PAYLOAD_WIDTH = 6
     MAX_TIMERS = 2
 
+    # Follower commit bound on AppendEntries. False (correct, Raft §5.3
+    # "index of last new entry"): commit caps at prev_idx(+1 with an
+    # entry). True reproduces the classic overcommit bug — capping at
+    # the follower's whole log length lets a stale divergent tail that
+    # extends past the match point be committed. The engine found this
+    # at seed 66531 of an 88k-seed sweep (LOG_MATCHING violated: one
+    # node committed term-1 entries 6-8 where the cluster committed
+    # term-2 ones); kept as a flag so the bug class stays testable.
+    COMMIT_TO_LOG_LEN = False
+
     def __init__(self, num_nodes: int = 5, log_capacity: int = 8):
         self.NUM_NODES = num_nodes
         self.MAX_MSGS = num_nodes - 1
@@ -333,6 +343,14 @@ class RaftMachine(Machine):
                 jnp.where(existing_matches, jnp.maximum(nodes.log_len[node], prev_idx + 1), prev_idx + 1),
                 nodes.log_len[node],
             )
+            # Raft §5.3: commit caps at the index of the last entry THIS
+            # AE verified (prev_idx, +1 if it carried an entry) — not at
+            # the follower's log length, whose tail past the match point
+            # may be stale (see COMMIT_TO_LOG_LEN above).
+            last_new = prev_idx + jnp.where(has_entry, 1, 0)
+            commit_cap = jnp.where(
+                jnp.bool_(self.COMMIT_TO_LOG_LEN), new_len, jnp.minimum(last_new, new_len)
+            )
             nodes = update_node(
                 nodes, node,
                 log_term=jnp.where(
@@ -341,7 +359,7 @@ class RaftMachine(Machine):
                 log_len=new_len,
                 commit=jnp.where(
                     ok,
-                    jnp.maximum(nodes.commit[node], jnp.minimum(leader_commit, new_len)),
+                    jnp.maximum(nodes.commit[node], jnp.minimum(leader_commit, commit_cap)),
                     nodes.commit[node],
                 ),
             )

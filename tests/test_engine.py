@@ -122,6 +122,35 @@ def test_buggy_protocol_found_and_replayed(raft_engine):
     assert len(rp.trace) > 0  # full event history available for debugging
 
 
+def test_raft_overcommit_bug_found_at_scale_and_fixed():
+    """Regression for a real bug the engine found at seed 66531 of an
+    88k-seed real-chip sweep: the follower capped its commit index at
+    its own log length instead of Raft §5.3's "index of last new entry",
+    so a stale divergent tail extending past the AE match point got
+    committed (LOG_MATCHING: one node committed term-1 entries 6-8 where
+    the cluster committed term-2 ones). The buggy bound is kept behind
+    COMMIT_TO_LOG_LEN; the exact found seed must fail with it and pass
+    without it."""
+
+    class OvercommitRaft(RaftMachine):
+        COMMIT_TO_LOG_LEN = True
+
+    cfg = EngineConfig(
+        horizon_us=5_000_000,
+        queue_capacity=32,
+        faults=FaultPlan(
+            n_faults=2, t_max_us=3_000_000, dur_min_us=200_000, dur_max_us=800_000
+        ),
+    )
+    from madsim_tpu.models.raft import LOG_MATCHING
+
+    rp_bad = replay(Engine(OvercommitRaft(5, 8), cfg), 66531, max_steps=2000)
+    assert bool(rp_bad.failed) and int(rp_bad.fail_code) == LOG_MATCHING
+
+    rp_good = replay(Engine(RaftMachine(5, 8), cfg), 66531, max_steps=2000)
+    assert not bool(rp_good.failed), f"fix did not hold: code {int(rp_good.fail_code)}"
+
+
 def test_seed_sharding_over_mesh(raft_engine):
     cpus = jax.devices("cpu")
     if len(cpus) < 2:
