@@ -45,6 +45,18 @@ __all__ = [
 _EOS = ("__eos__",)  # end-of-stream marker (reference streams `()` as terminator)
 
 
+class _RspEnvelope:
+    """Wire wrapper carrying a final response value + its metadata
+    (tonic carries metadata in HTTP/2 headers/trailers; the sim moves it
+    alongside the message object)."""
+
+    __slots__ = ("value", "metadata")
+
+    def __init__(self, value, metadata):
+        self.value = value
+        self.metadata = metadata
+
+
 class Code:
     """gRPC status codes (subset; reference: tonic::Code)."""
 
@@ -59,18 +71,26 @@ class Code:
     RESOURCE_EXHAUSTED = 8
     FAILED_PRECONDITION = 9
     ABORTED = 10
+    OUT_OF_RANGE = 11
     UNIMPLEMENTED = 12
     INTERNAL = 13
     UNAVAILABLE = 14
+    DATA_LOSS = 15
+    UNAUTHENTICATED = 16
 
 
 class Status(SimError):
     """RPC error status (reference: tonic::Status)."""
 
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, metadata: Optional[Dict[str, str]] = None):
         super().__init__(f"status {code}: {message}")
         self.code = code
         self.message = message
+        self.metadata: Dict[str, str] = dict(metadata or {})
+
+    @staticmethod
+    def unauthenticated(msg: str) -> "Status":
+        return Status(Code.UNAUTHENTICATED, msg)
 
     @staticmethod
     def unavailable(msg: str) -> "Status":
@@ -90,22 +110,26 @@ class Status(SimError):
 
 
 class Request:
-    """Request wrapper (reference: tonic::Request)."""
+    """Request wrapper (reference: tonic::Request). `metadata` travels
+    with the call (tonic: HTTP/2 headers) — populate it client-side and
+    read it in handlers via `request.metadata`."""
 
-    def __init__(self, message: Any):
+    def __init__(self, message: Any, metadata: Optional[Dict[str, str]] = None):
         self.message = message
-        self.metadata: Dict[str, str] = {}
+        self.metadata: Dict[str, str] = dict(metadata or {})
 
     def into_inner(self) -> Any:
         return self.message
 
 
 class Response:
-    """Response wrapper (reference: tonic::Response)."""
+    """Response wrapper (reference: tonic::Response). Handler-set
+    `metadata` rides back to the caller (tonic: response headers) and is
+    visible when the client passed a `Request` wrapper in."""
 
-    def __init__(self, message: Any):
+    def __init__(self, message: Any, metadata: Optional[Dict[str, str]] = None):
         self.message = message
-        self.metadata: Dict[str, str] = {}
+        self.metadata: Dict[str, str] = dict(metadata or {})
 
     def into_inner(self) -> Any:
         return self.message
@@ -202,6 +226,14 @@ class Router:
 
     def __init__(self) -> None:
         self._services: Dict[str, Any] = {}
+        self._interceptor: Optional[Callable[[Request], Request]] = None
+
+    def intercept(self, fn: Callable[[Request], Request]) -> "Router":
+        """Server interceptor (tonic: `service_with_interceptor` /
+        tower layer): runs on every incoming Request before dispatch;
+        raise `Status` to reject (e.g. UNAUTHENTICATED)."""
+        self._interceptor = fn
+        return self
 
     # no-op HTTP/2 config surface (parity with the reference's builder)
     def timeout(self, *_a, **_k) -> "Router":
@@ -257,7 +289,7 @@ class Router:
         head = await rx.recv()
         if head is None:
             return
-        path, _server_streaming, shape, first = head
+        path, _server_streaming, shape, first, req_md = head
         try:
             _, svc_name, method = path.split("/")
         except ValueError:
@@ -273,15 +305,26 @@ class Router:
             return
         attr, decl_shape = entry
         handler = getattr(svc, attr)
+        request = Request(first, req_md)
+        if self._interceptor is not None:
+            try:
+                request = self._interceptor(request)
+            except Status as status:
+                tx.send(status)
+                return
+
+        def _final(rsp) -> _RspEnvelope:
+            if isinstance(rsp, Response):
+                return _RspEnvelope(rsp.into_inner(), rsp.metadata)
+            return _RspEnvelope(rsp, {})
+
         try:
             if decl_shape == SHAPE_UNARY:
-                rsp = await handler(Request(first))
-                tx.send(rsp.into_inner() if isinstance(rsp, Response) else rsp)
+                tx.send(_final(await handler(request)))
             elif decl_shape == SHAPE_CLIENT_STREAMING:
-                rsp = await handler(Streaming(rx))
-                tx.send(rsp.into_inner() if isinstance(rsp, Response) else rsp)
+                tx.send(_final(await handler(Streaming(rx))))
             elif decl_shape == SHAPE_SERVER_STREAMING:
-                async for item in handler(Request(first)):
+                async for item in handler(request):
                     tx.send(item)
             else:  # bidi
                 async for item in handler(Streaming(rx)):
@@ -306,11 +349,24 @@ class Channel:
     connect = DNS lookup + ephemeral bind; `timeout` honored on calls,
     other knobs ignored (reference: channel.rs:23-140)."""
 
-    def __init__(self, target: str, timeout: Optional[float] = None):
+    def __init__(
+        self,
+        target: str,
+        timeout: Optional[float] = None,
+        interceptor: Optional[Callable[[Request], Request]] = None,
+    ):
         self._target = target
         self._timeout = timeout
+        self._interceptor = interceptor
         self._ep: Optional[Endpoint] = None
         self._addr = None
+
+    def with_interceptor(self, fn: Callable[[Request], Request]) -> "Channel":
+        """Client interceptor (tonic: `GreeterClient::with_interceptor`):
+        runs on every outgoing Request — inject metadata (auth tokens),
+        or raise `Status` to fail the call locally."""
+        self._interceptor = fn
+        return self
 
     async def _connect(self) -> None:
         target = self._target
@@ -324,34 +380,56 @@ class Channel:
         tx, rx = await self._ep.connect1(self._addr)
         tx.close()
 
-    async def _open(self, path: str, shape: str, first: Any):
+    def _prepare(self, msg: Any) -> tuple:
+        """Normalize a raw message or Request wrapper through the
+        interceptor. Returns (payload, metadata, wrapped) — `wrapped`
+        decides whether the caller gets a Response wrapper back."""
+        wrapped = isinstance(msg, Request)
+        request = msg if wrapped else Request(msg)
+        if self._interceptor is not None:
+            request = self._interceptor(request)
+        return request.into_inner(), request.metadata, wrapped
+
+    async def _open(self, path: str, shape: str, first: Any, metadata: Dict[str, str]):
         assert self._ep is not None
         tx, rx = await self._ep.connect1(self._addr)
-        tx.send((path, shape in (SHAPE_SERVER_STREAMING, SHAPE_STREAMING), shape, first))
+        tx.send((path, shape in (SHAPE_SERVER_STREAMING, SHAPE_STREAMING), shape, first, metadata))
         return tx, rx
 
+    @staticmethod
+    def _unwrap(rsp: Any, wrapped: bool) -> Any:
+        if isinstance(rsp, _RspEnvelope):
+            return Response(rsp.value, rsp.metadata) if wrapped else rsp.value
+        return Response(rsp) if wrapped else rsp
+
     async def unary(self, path: str, msg: Any) -> Any:
-        """Reference: client.rs Grpc::unary."""
+        """Reference: client.rs Grpc::unary. Pass a `Request` to send
+        metadata and receive a `Response` (with metadata) back; raw
+        messages round-trip as raw messages."""
         from ..time import timeout as time_timeout
 
+        payload, md, wrapped = self._prepare(msg)
+
         async def go():
-            tx, rx = await self._open(path, SHAPE_UNARY, msg)
+            tx, rx = await self._open(path, SHAPE_UNARY, payload, md)
             rsp = await rx.recv()
             if isinstance(rsp, Status):
                 raise rsp
             if rsp is None:
                 raise Status.unavailable("connection closed")
-            return rsp
+            return self._unwrap(rsp, wrapped)
 
         if self._timeout is not None:
             return await time_timeout(self._timeout, go())
         return await go()
 
-    async def client_streaming(self, path: str, messages) -> Any:
+    async def client_streaming(self, path: str, messages, metadata: Optional[Dict[str, str]] = None) -> Any:
         from ..time import timeout as time_timeout
 
+        _p, md, wrapped = self._prepare(Request(None, metadata) if metadata else None)
+
         async def go():
-            tx, rx = await self._open(path, SHAPE_CLIENT_STREAMING, None)
+            tx, rx = await self._open(path, SHAPE_CLIENT_STREAMING, None, md)
             async for m in _aiter(messages):
                 tx.send(m)
             tx.send(_EOS)
@@ -360,7 +438,7 @@ class Channel:
                 raise rsp
             if rsp is None:
                 raise Status.unavailable("connection closed")
-            return rsp
+            return self._unwrap(rsp, wrapped)
 
         if self._timeout is not None:
             return await time_timeout(self._timeout, go())
@@ -373,18 +451,20 @@ class Channel:
         not per-stream-element."""
         from ..time import timeout as time_timeout
 
+        payload, md, _wrapped = self._prepare(msg)
         if self._timeout is not None:
             tx, rx = await time_timeout(
-                self._timeout, self._open(path, SHAPE_SERVER_STREAMING, msg)
+                self._timeout, self._open(path, SHAPE_SERVER_STREAMING, payload, md)
             )
         else:
-            tx, rx = await self._open(path, SHAPE_SERVER_STREAMING, msg)
+            tx, rx = await self._open(path, SHAPE_SERVER_STREAMING, payload, md)
         return Streaming(rx)
 
-    async def streaming(self, path: str, messages) -> Streaming:
+    async def streaming(self, path: str, messages, metadata: Optional[Dict[str, str]] = None) -> Streaming:
         from ..task import spawn
 
-        tx, rx = await self._open(path, SHAPE_STREAMING, None)
+        _p, md, _wrapped = self._prepare(Request(None, metadata) if metadata else None)
+        tx, rx = await self._open(path, SHAPE_STREAMING, None, md)
 
         async def feed():
             async for m in _aiter(messages):
@@ -395,11 +475,15 @@ class Channel:
         return Streaming(rx)
 
 
-async def connect(target: str, timeout: Optional[float] = None) -> Channel:
+async def connect(
+    target: str,
+    timeout: Optional[float] = None,
+    interceptor: Optional[Callable[[Request], Request]] = None,
+) -> Channel:
     """Connect a channel (reference: Endpoint::connect).
 
     Raises `Status(UNAVAILABLE)` if the server is unreachable."""
-    ch = Channel(target, timeout=timeout)
+    ch = Channel(target, timeout=timeout, interceptor=interceptor)
     try:
         await ch._connect()
     except (ConnectionRefused, ConnectionReset, OSError) as exc:
