@@ -170,6 +170,13 @@ class Broker:
             raise KafkaError("partitions must be >= 1", ErrorCode.INVALID_ARG)
         self.topics[name] = [Partition() for _ in range(partitions)]
         self._rr[name] = 0
+        # groups with members already subscribed to this topic rebalance
+        # to pick up its partitions (rdkafka: subscribing to a
+        # not-yet-created topic is not fatal — a metadata refresh assigns
+        # it once it exists; members learn via the heartbeat fence)
+        for g in self.groups.values():
+            if any(name in m.topics for m in g.members.values()):
+                self._rebalance(g)
 
     def _partition(self, topic: str, partition: int) -> Partition:
         parts = self.topics.get(topic)
@@ -710,13 +717,16 @@ class BaseConsumer:
         resumes from the group's committed offset. Without one: assign
         all partitions from `auto.offset.reset`."""
         meta = await self._conn.call(("metadata",))
-        for t in topics:
-            if t not in meta:
-                raise KafkaError(f"unknown topic: {t}", ErrorCode.UNKNOWN_TOPIC_OR_PART)
         if self._group:
+            # group mode: unknown topics are not fatal (rdkafka queues an
+            # UNKNOWN_TOPIC_OR_PART event but keeps the subscription; the
+            # broker rebalances us in when the topic is created)
             self._sub_topics = list(topics)
             await self._rejoin()
             return
+        for t in topics:
+            if t not in meta:
+                raise KafkaError(f"unknown topic: {t}", ErrorCode.UNKNOWN_TOPIC_OR_PART)
         for t in topics:
             for partid in range(meta[t]):
                 start: Union[str, int] = (

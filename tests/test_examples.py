@@ -64,3 +64,9 @@ def test_bug_hunt_example():
     assert "failed=True" in r.stdout
     assert ("traces diverge at step" in r.stdout
             or "no passing seed" in r.stdout)
+
+
+def test_group_consumers_example():
+    r = _run("group_consumers.py", "7")
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "at-least-once holds" in r.stdout

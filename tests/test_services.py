@@ -1311,3 +1311,47 @@ def test_s3_content_type_and_user_metadata():
         return await c.spawn(go())
 
     assert run(main)
+
+
+def test_kafka_subscribe_before_topic_created():
+    """Group members that subscribe before the topic exists are not
+    fatal-errored (rdkafka keeps the subscription); creating the topic
+    triggers a rebalance that assigns them the new partitions."""
+
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await kafka.SimBroker().serve("0.0.0.0:9092")
+
+        handle.create_node().name("broker").ip("10.7.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        c = handle.create_node().ip("10.7.0.2").build()
+
+        async def go():
+            gcfg = kafka.ClientConfig(
+                {"bootstrap.servers": "10.7.0.1:9092", "group.id": "early",
+                 "session.timeout.ms": "500", "heartbeat.interval.ms": "100"}
+            )
+            consumer = await gcfg.create_base_consumer()
+            await consumer.subscribe(["later"])  # does not exist yet
+
+            cfg = kafka.ClientConfig({"bootstrap.servers": "10.7.0.1:9092"})
+            admin = await cfg.create_admin()
+            await admin.create_topics([kafka.NewTopic("later", 2)])
+            prod = await cfg.create_future_producer()
+            await prod.send_and_wait(kafka.FutureRecord("later", payload=b"x", partition=0))
+            await prod.send_and_wait(kafka.FutureRecord("later", payload=b"y", partition=1))
+
+            got = set()
+            deadline = sim_time.now() + 10.0
+            while len(got) < 2 and sim_time.now() < deadline:
+                msg = await consumer.poll(timeout=0.5)
+                if msg is not None:
+                    got.add(msg.payload)
+            assert got == {b"x", b"y"}, got
+            return True
+
+        return await c.spawn(go())
+
+    assert run(main)
