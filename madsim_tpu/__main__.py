@@ -88,6 +88,39 @@ def cmd_explore(args) -> int:
         return 1 if out["failing"] else 0
 
     eng = _build_engine(args)
+    if args.stream:
+        # seed streaming: finished lanes refill with fresh seeds — the
+        # high-throughput path for large batches (bench.py's path)
+        import time as wall
+
+        batch = min(args.seeds, args.batch)
+        eng.run_stream(1, batch=batch, segment_steps=384, max_steps=args.max_steps)
+        t0 = wall.perf_counter()
+        out = eng.run_stream(
+            args.seeds, batch=batch, segment_steps=384,
+            seed_start=args.seed, max_steps=args.max_steps,
+        )
+        el = wall.perf_counter() - t0
+        failing = out["failing"]
+        print(
+            f"streamed {out['completed']} seeds in {el:.1f}s "
+            f"({out['completed']/el:.0f} seeds/s), {len(failing)} failing, "
+            f"{len(out['abandoned'])} abandoned"
+        )
+        if failing:
+            codes = sorted({c for _s, c in failing})
+            print(f"failure codes: {codes}")
+            print(f"failing seeds: {[s for s, _ in failing[:20]]}"
+                  f"{' ...' if len(failing) > 20 else ''}")
+            print(
+                f"reproduce: python -m madsim_tpu replay --machine {args.machine} "
+                f"--seed {failing[0][0]} --nodes {args.nodes} --horizon {args.horizon} "
+                f"--queue {args.queue} --faults {args.faults} --loss {args.loss} "
+                f"--max-steps {args.max_steps}"
+            )
+            return 1
+        return 0
+
     seeds = jnp.arange(args.seed, args.seed + args.seeds, dtype=jnp.uint32)
     res = eng.make_runner(max_steps=args.max_steps)(seeds)
     failing = eng.failing_seeds(res).tolist()
@@ -237,6 +270,11 @@ def main(argv=None) -> int:
     p = sub.add_parser("explore", help="run a seed batch, report failing seeds")
     common(p)
     p.add_argument("--seeds", type=int, default=1024)
+    p.add_argument(
+        "--stream", action="store_true",
+        help="seed-streaming path (refill finished lanes; for large batches)",
+    )
+    p.add_argument("--batch", type=int, default=8192, help="lanes per streaming batch")
     p.add_argument(
         "--multihost", action="store_true",
         help="shard the batch over a jax.distributed job "
