@@ -16,31 +16,56 @@ determinism-check mode, as a command line:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 
 
-def _build_engine(args):
-    from .engine import Engine, EngineConfig, FaultPlan
+def build_machine(name: str, nodes: int = 0):
+    """CLI machine registry — also the resolver corpus entries use to
+    rebuild their machine from (name, nodes). The demo-* entries are
+    deliberately buggy variants (each models a classic bug class) so the
+    hunt -> shrink -> replay -> corpus workflow is demonstrable without
+    writing a protocol first."""
     from .models.echo import EchoMachine
     from .models.etcd import EtcdMachine
-    from .models.kafka_group import KafkaGroupMachine
+    from .models.kafka_group import KafkaGroupMachine, NoFencingGroupMachine
     from .models.kv import KvMachine
     from .models.mq import MqMachine
     from .models.raft import RaftMachine
     from .models.twopc import TwoPcMachine
 
+    class DoubleGrantEtcd(EtcdMachine):
+        CHECK_OWNER_ON_CAMPAIGN = False  # non-atomic election txn
+
+    class OvercommitRaft(RaftMachine):
+        COMMIT_TO_LOG_LEN = True  # Raft §5.3 commit-bound bug
+
     machines = {
         "echo": lambda: EchoMachine(rounds=10),
-        "raft": lambda: RaftMachine(num_nodes=args.nodes or 5, log_capacity=8),
-        "kv": lambda: KvMachine(num_nodes=args.nodes or 4),
-        "mq": lambda: MqMachine(num_nodes=args.nodes or 4),
-        "etcd": lambda: EtcdMachine(num_nodes=args.nodes or 4),
-        "twopc": lambda: TwoPcMachine(num_nodes=args.nodes or 4),
-        "group": lambda: KafkaGroupMachine(num_nodes=args.nodes or 4),
+        "raft": lambda: RaftMachine(num_nodes=nodes or 5, log_capacity=8),
+        "kv": lambda: KvMachine(num_nodes=nodes or 4),
+        "mq": lambda: MqMachine(num_nodes=nodes or 4),
+        "etcd": lambda: EtcdMachine(num_nodes=nodes or 4),
+        "twopc": lambda: TwoPcMachine(num_nodes=nodes or 4),
+        "group": lambda: KafkaGroupMachine(num_nodes=nodes or 4),
+        "demo-doublegrant-etcd": lambda: DoubleGrantEtcd(
+            num_nodes=nodes or 4, target_gens=99, target_writes=9999
+        ),
+        "demo-overcommit-raft": lambda: OvercommitRaft(
+            num_nodes=nodes or 5, log_capacity=8
+        ),
+        "demo-nofencing-group": lambda: NoFencingGroupMachine(num_nodes=nodes or 4),
     }
-    if args.machine not in machines:
-        sys.exit(f"unknown machine {args.machine!r}; choose from {sorted(machines)}")
+    if name not in machines:
+        sys.exit(f"unknown machine {name!r}; choose from {sorted(machines)}")
+    return machines[name]()
+
+
+def _build_engine(args):
+    from .engine import Engine, EngineConfig, FaultPlan
+
+    machine = build_machine(args.machine, args.nodes)
     cfg = EngineConfig(
         # round, not truncate: a shrunk repro prints horizon_us/1e6 and
         # float truncation would shave the failing event off the horizon
@@ -56,7 +81,42 @@ def _build_engine(args):
             dur_max_us=800_000,
         ),
     )
-    return Engine(machines[args.machine](), cfg)
+    return Engine(machine, cfg)
+
+
+def _repro_line(args, seed) -> str:
+    """A replay command that reproduces `seed` exactly — including the
+    resolved --fault-tmax, which is load-bearing: without it a replay
+    with a different --horizon would draw a different fault schedule."""
+    tmax = args.fault_tmax or int(args.horizon * 0.6e6) or 1
+    return (
+        f"reproduce: python -m madsim_tpu replay --machine {args.machine} "
+        f"--seed {seed} --nodes {args.nodes} --horizon {args.horizon} "
+        f"--queue {args.queue} --faults {args.faults} --loss {args.loss} "
+        f"--fault-tmax {tmax} --max-steps {args.max_steps}"
+    )
+
+
+def _find_failing(eng, args):
+    """Run the seed batch (streaming or fixed) and return
+    (failing [(seed, code), ...], abandoned_count)."""
+    if args.stream:
+        out = eng.run_stream(
+            args.seeds, batch=min(args.seeds, args.batch), segment_steps=384,
+            seed_start=args.seed, max_steps=args.max_steps,
+        )
+        return out["failing"], len(out["abandoned"])
+    import jax.numpy as jnp
+
+    seeds = jnp.arange(args.seed, args.seed + args.seeds, dtype=jnp.uint32)
+    res = eng.make_runner(max_steps=args.max_steps)(seeds)
+    failing = [
+        (int(s), int(c))
+        for s, c in zip(
+            eng.failing_seeds(res).tolist(), res.fail_code[res.failed].tolist()
+        )
+    ]
+    return failing, 0
 
 
 def cmd_explore(args) -> int:
@@ -112,12 +172,7 @@ def cmd_explore(args) -> int:
             print(f"failure codes: {codes}")
             print(f"failing seeds: {[s for s, _ in failing[:20]]}"
                   f"{' ...' if len(failing) > 20 else ''}")
-            print(
-                f"reproduce: python -m madsim_tpu replay --machine {args.machine} "
-                f"--seed {failing[0][0]} --nodes {args.nodes} --horizon {args.horizon} "
-                f"--queue {args.queue} --faults {args.faults} --loss {args.loss} "
-                f"--max-steps {args.max_steps}"
-            )
+            print(_repro_line(args, failing[0][0]))
             return 1
         return 0
 
@@ -131,14 +186,94 @@ def cmd_explore(args) -> int:
         codes = sorted({int(c) for c in res.fail_code.tolist() if c != 0})
         print(f"failure codes: {codes}")
         print(f"failing seeds: {failing[:20]}{' ...' if len(failing) > 20 else ''}")
-        print(
-            f"reproduce: python -m madsim_tpu replay --machine {args.machine} "
-            f"--seed {failing[0]} --nodes {args.nodes} --horizon {args.horizon} "
-            f"--queue {args.queue} --faults {args.faults} --loss {args.loss} "
-            f"--max-steps {args.max_steps}"
-        )
+        print(_repro_line(args, failing[0]))
         return 1
     return 0
+
+
+def cmd_hunt(args) -> int:
+    """explore -> shrink -> corpus: every found failing seed becomes a
+    durable "open" regression entry with its minimized config."""
+    from .engine import corpus, shrink
+
+    eng = _build_engine(args)
+    failing, abandoned = _find_failing(eng, args)
+    print(
+        f"hunted {args.seeds} seeds: {len(failing)} failing"
+        + (f", {abandoned} abandoned (over --max-steps)" if abandoned else "")
+    )
+    entries = corpus.load(args.corpus)
+    known = {e.key for e in entries}
+    added = 0
+    for seed, code in failing[: args.limit]:
+        try:
+            sr = shrink(eng, seed, max_steps=args.max_steps)
+        except ValueError as exc:
+            # device-flagged but not reproducing on the host replay —
+            # report it (that drift is itself a finding) and keep going
+            print(f"  ! seed {seed} code {code}: {exc}")
+            continue
+        entry = corpus.CorpusEntry(
+            machine=args.machine,
+            nodes=args.nodes,
+            seed=seed,
+            fail_code=code,
+            status=corpus.STATUS_OPEN,
+            config=sr.shrunk,
+            max_steps=sr.steps + 1,
+            note=sr.summary(),
+        )
+        if entry.key in known:
+            print(f"  = corpus: seed {seed} code {code} already recorded")
+            continue
+        known.add(entry.key)
+        entries.append(entry)
+        added += 1
+        print(f"  + corpus: {sr.summary()}")
+    if added:
+        corpus.save(args.corpus, entries)
+    if failing[args.limit :]:
+        print(f"  ({len(failing) - args.limit} further failing seeds not shrunk; raise --limit)")
+    print(f"{added} new entries in {args.corpus}")
+    return 1 if failing else 0
+
+
+def cmd_regress(args) -> int:
+    """Re-verify every corpus entry against its status contract: open
+    entries must still reproduce their exact failure; fixed entries must
+    keep passing. `--promote` flips open entries that no longer fail."""
+    from .engine import corpus
+
+    entries = corpus.load(args.corpus)
+    if not entries:
+        print(f"corpus {args.corpus} is empty")
+        return 0
+    bad = 0
+    changed = False
+    for i, e in enumerate(entries):
+        try:
+            out = corpus.check(e, build_machine)
+        except SystemExit:
+            # unknown machine name (renamed registry entry / foreign
+            # corpus) must not kill the run — later entries still get
+            # checked and pending --promote updates still get saved
+            print(f"[FAIL] {e.machine} seed {e.seed}: unknown machine in registry")
+            bad += 1
+            continue
+        tag = "ok " if out.ok else "FAIL"
+        print(f"[{tag}] {e.machine} seed {e.seed} code {e.fail_code} ({e.status}): {out.verdict}")
+        if not out.ok:
+            if args.promote and e.status == corpus.STATUS_OPEN and not out.failed:
+                entries[i] = dataclasses.replace(e, status=corpus.STATUS_FIXED)
+                changed = True
+                print(f"       promoted to {corpus.STATUS_FIXED}")
+            else:
+                bad += 1
+    if changed:
+        corpus.save(args.corpus, entries)
+        print(f"corpus updated: {args.corpus}")
+    print(f"{len(entries) - bad}/{len(entries)} entries satisfied")
+    return 1 if bad else 0
 
 
 def cmd_replay(args) -> int:
@@ -290,6 +425,28 @@ def main(argv=None) -> int:
     p = sub.add_parser("shrink", help="minimize a failing seed's config")
     common(p)
     p.set_defaults(fn=cmd_shrink)
+
+    p = sub.add_parser(
+        "hunt", help="explore + shrink + record failing seeds in the corpus"
+    )
+    common(p)
+    p.add_argument("--seeds", type=int, default=1024)
+    p.add_argument("--stream", action="store_true", help="seed-streaming hunt")
+    p.add_argument("--batch", type=int, default=8192, help="lanes per streaming batch")
+    p.add_argument("--corpus", default="corpus.json")
+    p.add_argument("--limit", type=int, default=5, help="max seeds to shrink+record")
+    p.set_defaults(fn=cmd_hunt)
+
+    p = sub.add_parser(
+        "regress",
+        help="re-verify every corpus entry (open must reproduce, fixed must pass)",
+    )
+    p.add_argument("--corpus", default="corpus.json")
+    p.add_argument(
+        "--promote", action="store_true",
+        help="flip open entries that no longer fail to fixed",
+    )
+    p.set_defaults(fn=cmd_regress)
 
     p = sub.add_parser("check", help="engine determinism self-check")
     common(p)

@@ -35,6 +35,7 @@ from .machine import (
     update_node,
 )
 from .replay import ReplayResult, TraceEvent, decode_ring, replay, replay_diff
+from . import corpus
 from .shrink import ShrinkResult, shrink
 
 __all__ = [
@@ -56,6 +57,7 @@ __all__ = [
     "replay_diff",
     "decode_ring",
     "shrink",
+    "corpus",
     "ShrinkResult",
     "ReplayResult",
     "TraceEvent",

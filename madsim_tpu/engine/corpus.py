@@ -1,0 +1,145 @@
+"""Failing-seed corpus — found bugs as durable regression artifacts.
+
+The reference's workflow stops at printing `MADSIM_TEST_SEED=N` repro
+hints; FoundationDB-style DST practice goes further: every found seed
+becomes a corpus entry that is re-verified forever. An entry is "open"
+while the bug reproduces (the repro must keep failing — if it stops,
+the bug was fixed, or the repro rotted) and "fixed" once resolved (the
+seed must pass forever — failing again is a regression alarm).
+
+Entries carry everything needed to rebuild the run: machine name (CLI
+registry), node count, seed, expected fail code, the (shrunk) engine
+config, and a sufficient step budget. `python -m madsim_tpu hunt`
+explores + shrinks + appends; `python -m madsim_tpu regress` re-verifies
+every entry bit-identically on the host replay path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, List, Optional
+
+from .core import Engine, EngineConfig, FaultPlan
+from .replay import replay
+
+STATUS_OPEN = "open"    # bug reproduces: entry must keep failing with its code
+STATUS_FIXED = "fixed"  # bug resolved: entry must keep passing
+
+
+def config_to_dict(cfg: EngineConfig) -> dict:
+    d = dataclasses.asdict(cfg)
+    return d
+
+
+def config_from_dict(d: dict) -> EngineConfig:
+    d = dict(d)
+    faults = d.pop("faults", None)
+    cfg = EngineConfig(**d, faults=FaultPlan(**faults) if faults else FaultPlan())
+    return cfg
+
+
+@dataclasses.dataclass
+class CorpusEntry:
+    machine: str
+    seed: int
+    fail_code: int
+    status: str  # STATUS_OPEN | STATUS_FIXED
+    config: EngineConfig
+    max_steps: int
+    nodes: int = 0
+    note: str = ""
+
+    @property
+    def key(self) -> tuple:
+        return (self.machine, self.nodes, self.seed, self.fail_code)
+
+    def to_dict(self) -> dict:
+        return {
+            "machine": self.machine,
+            "nodes": self.nodes,
+            "seed": self.seed,
+            "fail_code": self.fail_code,
+            "status": self.status,
+            "max_steps": self.max_steps,
+            "note": self.note,
+            "config": config_to_dict(self.config),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "CorpusEntry":
+        return CorpusEntry(
+            machine=d["machine"],
+            nodes=int(d.get("nodes", 0)),
+            seed=int(d["seed"]),
+            fail_code=int(d["fail_code"]),
+            status=d.get("status", STATUS_OPEN),
+            max_steps=int(d["max_steps"]),
+            note=d.get("note", ""),
+            config=config_from_dict(d["config"]),
+        )
+
+
+def load(path: str) -> List[CorpusEntry]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    return [CorpusEntry.from_dict(d) for d in data.get("entries", [])]
+
+
+def save(path: str, entries: List[CorpusEntry]) -> None:
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": 1, "entries": [e.to_dict() for e in entries]}, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def add(path: str, entry: CorpusEntry) -> bool:
+    """Append an entry unless one with the same (machine, nodes, seed,
+    code) already exists. Returns True if added."""
+    entries = load(path)
+    if any(e.key == entry.key for e in entries):
+        return False
+    entries.append(entry)
+    save(path, entries)
+    return True
+
+
+@dataclasses.dataclass
+class RegressOutcome:
+    entry: CorpusEntry
+    failed: bool            # did the replay fail
+    fail_code: int
+    ok: bool                # outcome matches the entry's status contract
+    verdict: str            # human-readable disposition
+
+
+def check(entry: CorpusEntry, build_machine: Callable[[str, int], object]) -> RegressOutcome:
+    """Re-run one entry on the host replay path and judge it against its
+    status contract. `build_machine(name, nodes)` resolves the machine."""
+    eng = Engine(build_machine(entry.machine, entry.nodes), entry.config)
+    rp = replay(eng, entry.seed, max_steps=entry.max_steps, trace=False)
+    failed = bool(rp.failed)
+    code = int(rp.fail_code)
+    same_failure = failed and code == entry.fail_code
+    if entry.status == STATUS_OPEN:
+        if same_failure:
+            return RegressOutcome(entry, failed, code, True, "still open (reproduces)")
+        if failed:
+            return RegressOutcome(
+                entry, failed, code, False,
+                f"DRIFT: fails with code {code}, expected {entry.fail_code}",
+            )
+        return RegressOutcome(
+            entry, failed, code, False,
+            "appears FIXED (no longer reproduces) — re-run with --promote",
+        )
+    # STATUS_FIXED: must pass
+    if not failed:
+        return RegressOutcome(entry, failed, code, True, "fixed (still passes)")
+    return RegressOutcome(
+        entry, failed, code, False, f"REGRESSION: fails again with code {code}"
+    )

@@ -1,0 +1,95 @@
+"""Failing-seed corpus: hunt -> shrink -> record -> regress lifecycle.
+
+The corpus turns found seeds into durable regression artifacts with a
+status contract (open = must keep reproducing, fixed = must keep
+passing) — the FoundationDB-style workflow the reference's printed
+MADSIM_TEST_SEED hints stop short of."""
+
+import argparse
+
+import pytest
+
+from madsim_tpu.__main__ import build_machine, cmd_hunt, cmd_regress
+from madsim_tpu.engine import Engine, EngineConfig, FaultPlan, corpus, shrink
+
+
+def _demo_engine():
+    return Engine(
+        build_machine("demo-doublegrant-etcd"),
+        EngineConfig(
+            horizon_us=8_000_000,
+            queue_capacity=96,
+            faults=FaultPlan(n_faults=3, t_max_us=4_800_000,
+                             dur_min_us=100_000, dur_max_us=800_000),
+        ),
+    )
+
+
+def test_corpus_roundtrip_and_dedup(tmp_path):
+    path = str(tmp_path / "c.json")
+    cfg = EngineConfig(horizon_us=123_456, queue_capacity=32,
+                       faults=FaultPlan(n_faults=1, t_max_us=7))
+    e = corpus.CorpusEntry(
+        machine="demo-doublegrant-etcd", seed=5, fail_code=120,
+        status=corpus.STATUS_OPEN, config=cfg, max_steps=99, note="n",
+    )
+    assert corpus.add(path, e)
+    assert not corpus.add(path, e)  # dedup by (machine, nodes, seed, code)
+    [loaded] = corpus.load(path)
+    assert loaded.config == cfg  # config round-trips exactly
+    assert loaded.key == e.key and loaded.max_steps == 99
+
+
+def test_corpus_check_contracts():
+    eng = _demo_engine()
+    sr = shrink(eng, 0, max_steps=4000)
+    open_entry = corpus.CorpusEntry(
+        machine="demo-doublegrant-etcd", seed=0, fail_code=sr.fail_code,
+        status=corpus.STATUS_OPEN, config=sr.shrunk, max_steps=sr.steps + 1,
+    )
+    out = corpus.check(open_entry, build_machine)
+    assert out.ok and "still open" in out.verdict
+
+    # the same repro marked "fixed" is a regression alarm
+    import dataclasses
+
+    fixed_entry = dataclasses.replace(open_entry, status=corpus.STATUS_FIXED)
+    out2 = corpus.check(fixed_entry, build_machine)
+    assert not out2.ok and "REGRESSION" in out2.verdict
+
+    # an open entry on the HONEST machine (bug fixed) reports promotable
+    import dataclasses as dc
+
+    honest = dc.replace(open_entry, machine="etcd")
+    out3 = corpus.check(honest, build_machine)
+    assert not out3.ok and "FIXED" in out3.verdict
+
+
+def test_hunt_then_regress_cli(tmp_path):
+    path = str(tmp_path / "corpus.json")
+    hunt_args = argparse.Namespace(
+        machine="demo-doublegrant-etcd", nodes=0, seed=0, seeds=8,
+        horizon=8.0, queue=96, faults=3, loss=0.0, max_steps=4000,
+        fault_tmax=0, stream=False, batch=8192, corpus=path, limit=1,
+    )
+    rc = cmd_hunt(hunt_args)
+    assert rc == 1  # failing seeds found
+    entries = corpus.load(path)
+    assert len(entries) == 1 and entries[0].status == corpus.STATUS_OPEN
+    # the shrunk config is a real minimization: horizon cut to failure
+    assert entries[0].config.horizon_us < 8_000_000
+
+    regress_args = argparse.Namespace(corpus=path, promote=False)
+    assert cmd_regress(regress_args) == 0  # open entry reproduces: satisfied
+
+    # pointing the entry at the honest machine simulates "bug fixed":
+    # regress flags it, --promote flips it to fixed, and a second
+    # regress passes clean
+    import dataclasses
+
+    entries[0] = dataclasses.replace(entries[0], machine="etcd")
+    corpus.save(path, entries)
+    assert cmd_regress(argparse.Namespace(corpus=path, promote=False)) == 1
+    assert cmd_regress(argparse.Namespace(corpus=path, promote=True)) == 0
+    assert corpus.load(path)[0].status == corpus.STATUS_FIXED
+    assert cmd_regress(argparse.Namespace(corpus=path, promote=False)) == 0
