@@ -32,6 +32,7 @@ __all__ = [
     "SystemTime",
     "UNIX_EPOCH",
     "sleep",
+    "Sleep",
     "sleep_until",
     "timeout",
     "interval",
@@ -267,6 +268,84 @@ class SleepFuture(Pollable):
             self._armed = True
             th.add_timer_ns(self.deadline_ns, waker)
         return PENDING
+
+
+class Sleep(Pollable):
+    """Named, resettable sleep — tokio's `Sleep` handle (reference:
+    sim/time/sleep.rs `deadline`/`is_elapsed`/`reset`). Useful for
+    event-driven deadline patterns (election timers, idle timeouts)
+    that would otherwise be polling loops:
+
+        timer = Sleep.after(0.15)
+        ...
+        timer.reset_after(0.15)   # heartbeat arrived: push the deadline
+        await timer               # fires at the (latest) deadline
+
+    A reset to an *earlier* deadline while a task is parked re-arms
+    immediately; a reset to a later one turns the old timer into a
+    harmless spurious wake (re-poll re-arms). After firing it can be
+    reset and awaited again.
+    """
+
+    __slots__ = ("_deadline_ns", "_armed_for", "_waker")
+
+    def __init__(self, deadline_ns: int):
+        self._deadline_ns = deadline_ns
+        self._armed_for: Optional[int] = None
+        self._waker: Optional[Callable[[], None]] = None
+
+    @staticmethod
+    def after(duration: Union[int, float]) -> "Sleep":
+        th = _context.current_time()
+        return Sleep(th.now_ns() + to_ns(duration))
+
+    @staticmethod
+    def until(deadline: "Instant") -> "Sleep":
+        return Sleep(deadline._ns)
+
+    def deadline(self) -> "Instant":
+        return Instant(self._deadline_ns)
+
+    def is_elapsed(self) -> bool:
+        return _context.current_time().now_ns() >= self._deadline_ns
+
+    def reset(self, deadline: "Instant") -> None:
+        self.reset_ns(deadline._ns)
+
+    def reset_after(self, duration: Union[int, float]) -> None:
+        self.reset_ns(_context.current_time().now_ns() + to_ns(duration))
+
+    def reset_ns(self, deadline_ns: int) -> None:
+        self._deadline_ns = deadline_ns
+        if self._waker is not None and (
+            self._armed_for is None or deadline_ns < self._armed_for
+        ):
+            # a parked task would otherwise sleep to the OLD (later)
+            # deadline; arm the earlier one now
+            self._armed_for = deadline_ns
+            _context.current_time().add_timer_ns(deadline_ns, self._wake)
+
+    def _wake(self) -> None:
+        w = self._waker
+        if w is not None:
+            w()  # re-poll decides readiness; stale timers are spurious wakes
+
+    def poll(self, waker: Callable[[], None]):
+        th = _context.current_time()
+        if th.now_ns() >= self._deadline_ns:
+            self._waker = None
+            return Ready(None)
+        self._waker = waker
+        if self._armed_for != self._deadline_ns:
+            self._armed_for = self._deadline_ns
+            th.add_timer_ns(self._deadline_ns, self._wake)
+        return PENDING
+
+    def drop(self) -> None:
+        self._waker = None
+
+    def __await__(self):
+        return await_(self).__await__()
 
 
 def _sleep_pollable(th: "TimeHandle", deadline_ns: int):

@@ -184,3 +184,51 @@ def test_nested_timeouts_cancel_cascade():
     t_fired, inner_ran = run(main)
     assert t_fired == pytest.approx(2.0, abs=0.1)  # outer timeout fires first
     assert inner_ran is False
+
+
+def test_resettable_sleep_deadline_push_and_pull():
+    """tokio Sleep parity (reference: sleep.rs deadline/is_elapsed/reset):
+    pushing the deadline later delays the wake; pulling it earlier while
+    a task is parked wakes earlier; the handle is reusable after firing."""
+    from madsim_tpu.time import Sleep
+
+    async def main():
+        t0 = sim_time.now()
+        timer = sim_time.Sleep.after(1.0)
+        assert not timer.is_elapsed()
+
+        # another task pushes the deadline later (heartbeat pattern)
+        async def pusher():
+            await sim_time.sleep(0.5)
+            timer.reset_after(2.0)  # now fires at t=2.5
+
+        h = spawn(pusher())
+        await timer
+        assert abs(sim_time.now() - t0 - 2.5) < 1e-6, sim_time.now() - t0
+        assert timer.is_elapsed()
+        await h
+
+        # pull earlier while parked: a later-armed timer must not hold it
+        timer2 = sim_time.Sleep.after(10.0)
+
+        async def puller():
+            await sim_time.sleep(0.25)
+            timer2.reset_after(0.25)  # fires at t=+0.5, not +10
+
+        t1 = sim_time.now()
+        h2 = spawn(puller())
+        await timer2
+        assert abs(sim_time.now() - t1 - 0.5) < 1e-6
+        await h2
+
+        # reuse after firing
+        timer2.reset_after(0.125)
+        t2 = sim_time.now()
+        await timer2
+        assert abs(sim_time.now() - t2 - 0.125) < 1e-6
+
+        # deadline() reports the armed instant
+        assert timer2.deadline() <= sim_time.Instant.now()
+        return True
+
+    assert Runtime(seed=3).block_on(main())
