@@ -368,6 +368,21 @@ class Channel:
         self._interceptor = fn
         return self
 
+    # tonic 0.12 compression / message-size API surface: accepted and
+    # ignored, like the reference's no-op HTTP/2 knobs (messages move as
+    # objects — there is nothing to compress or size-limit)
+    def accept_compressed(self, *_a, **_k) -> "Channel":
+        return self
+
+    def send_compressed(self, *_a, **_k) -> "Channel":
+        return self
+
+    def max_decoding_message_size(self, *_a, **_k) -> "Channel":
+        return self
+
+    def max_encoding_message_size(self, *_a, **_k) -> "Channel":
+        return self
+
     async def _connect(self) -> None:
         target = self._target
         if target.startswith("http://") or target.startswith("https://"):

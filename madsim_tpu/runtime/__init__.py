@@ -20,7 +20,7 @@ from ..task.join import JoinHandle
 from ..time import TimeHandle
 from .metrics import RuntimeMetrics
 
-__all__ = ["Runtime", "Handle", "NodeBuilder", "NodeHandle", "init_logger"]
+__all__ = ["Runtime", "Handle", "NodeBuilder", "NodeHandle", "hostname", "init_logger"]
 
 
 def _default_simulators() -> List[Type[Simulator]]:
@@ -283,6 +283,16 @@ class NodeHandle:
         executor = self._handle._runtime.executor
         task = executor.spawn(coro, self._node, location=location, name=name)
         return JoinHandle(task)
+
+
+def hostname() -> str:
+    """The current node's name (reference 0.2.34: the libc gethostname
+    interposition returns the node's name, or `madsim-node-{id}` for
+    unnamed nodes — here that default is baked in at node creation, so
+    this is simply the name)."""
+    from .. import _context
+
+    return _context.current_task().node.name
 
 
 def init_logger(level: str = "INFO") -> None:

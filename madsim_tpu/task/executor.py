@@ -157,14 +157,14 @@ class Executor:
         from .. import _native
 
         self._native_mod = _native.get_mod()
-        self.main_node = self.create_node("main")
+        self.main_node = self.create_node("madsim-main")  # reference 0.2.34 rename
 
     # -- nodes --------------------------------------------------------------
 
     def create_node(self, name: str = "") -> NodeInfo:
         node_id = self._next_node_id
         self._next_node_id += 1
-        node = NodeInfo(node_id, name or f"node-{node_id}")
+        node = NodeInfo(node_id, name or f"madsim-node-{node_id}")
         self.nodes[node_id] = node
         for hook in self.create_hooks:
             hook(node_id)

@@ -292,3 +292,29 @@ def test_task_local_isolated_across_runtimes():
         return await spawn(probe())
 
     assert Runtime(seed=2).block_on(fresh()) == "clean"
+
+
+def test_hostname_and_default_node_names():
+    """Reference 0.2.34: the default node is `madsim-main`, unnamed
+    nodes are `madsim-node-{id}`, and hostname() returns the current
+    node's name."""
+    from madsim_tpu.runtime import Handle, hostname
+
+    async def main():
+        handle = Handle.current()
+        names = [hostname()]
+
+        unnamed = handle.create_node().build()
+        named = handle.create_node().name("web-1").build()
+
+        async def report():
+            names.append(hostname())
+
+        await unnamed.spawn(report())
+        await named.spawn(report())
+        return names
+
+    got = Runtime(seed=1).block_on(main())
+    assert got[0] == "madsim-main"
+    assert got[1].startswith("madsim-node-")
+    assert got[2] == "web-1"
