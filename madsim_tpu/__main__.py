@@ -32,6 +32,7 @@ def build_machine(name: str, nodes: int = 0):
     from .models.kafka_group import KafkaGroupMachine, NoFencingGroupMachine
     from .models.kv import KvMachine
     from .models.mq import MqMachine
+    from .models.paxos import NoPromiseCheckPaxos, PaxosMachine
     from .models.raft import RaftMachine
     from .models.twopc import TwoPcMachine
 
@@ -49,6 +50,8 @@ def build_machine(name: str, nodes: int = 0):
         "etcd": lambda: EtcdMachine(num_nodes=nodes or 4),
         "twopc": lambda: TwoPcMachine(num_nodes=nodes or 4),
         "group": lambda: KafkaGroupMachine(num_nodes=nodes or 4),
+        "paxos": lambda: PaxosMachine(num_nodes=nodes or 5),
+        "demo-nopromise-paxos": lambda: NoPromiseCheckPaxos(num_nodes=nodes or 5),
         "demo-doublegrant-etcd": lambda: DoubleGrantEtcd(
             num_nodes=nodes or 4, target_gens=99, target_writes=9999
         ),

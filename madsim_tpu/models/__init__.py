@@ -16,8 +16,10 @@ atomicity invariant (the atomic-commitment workload class).
 `kafka_group` — consumer-group coordinator with generations, session
 timeouts and fenced commits; at-least-once + no-commit-regression
 invariants (the rdkafka consumer-group workload, batched).
+`paxos` — single-decree Paxos with durable acceptors and dueling
+proposers; agreement invariant via a ghost chosen-register.
 """
 
-from . import echo, etcd, kafka_group, kv, mq, raft, twopc
+from . import echo, etcd, kafka_group, kv, mq, paxos, raft, twopc
 
-__all__ = ["echo", "etcd", "kafka_group", "kv", "mq", "raft", "twopc"]
+__all__ = ["echo", "etcd", "kafka_group", "kv", "mq", "paxos", "raft", "twopc"]

@@ -1,0 +1,301 @@
+"""Single-decree Paxos (synod) machine — the other classic consensus
+protocol, batched.
+
+Every node is an acceptor with durable (promised, accepted) state
+(Paxos's stable-storage requirement survives engine kill/restart
+faults); nodes 0 and 1 are also proposers, each proposing its own
+distinct value, retrying with ever-higher ballots on timeout. Ballots
+are globally unique via ballot = round * N + node.
+
+Checked invariant (AGREEMENT, code 140): at most one value is ever
+*chosen* (accepted by a majority at some ballot). Tracked with a ghost
+chosen-register on row 0 — written whenever a proposer observes a
+majority of ACCEPTED acks for its ballot, never read by the protocol.
+`NoPromiseCheckPaxos` drops the acceptor's ballot guard on ACCEPT (the
+classic implementation bug); under contention + partitions two
+proposers then get distinct values chosen, which the engine flags and
+replays bit-identically.
+
+Reference scenario family: consensus-under-chaos, same class the
+MadRaft workload covers for Raft (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..engine.machine import (
+    Machine,
+    Outbox,
+    make_payload,
+    send_if,
+    set_at,
+    set_timer_if,
+    update_node,
+)
+
+# messages
+M_PREPARE, M_PROMISE, M_ACCEPT, M_ACCEPTED, M_NACK = 1, 2, 3, 4, 5
+
+# timers
+T_BOOT, T_PROPOSE, T_RETRY = 0, 1, 2
+
+AGREEMENT = 140
+
+PROPOSE_MIN_US = 20_000
+PROPOSE_SPAN_US = 180_000
+RETRY_MIN_US = 150_000
+RETRY_SPAN_US = 250_000
+
+IDLE, PREPARING, ACCEPTING, DECIDED = 0, 1, 2, 3
+
+
+@struct.dataclass
+class PaxosState:
+    # acceptor (durable — Paxos stable storage)
+    promised: jax.Array  # int32[N] highest ballot promised (-1 none)
+    acc_ballot: jax.Array  # int32[N] ballot of accepted value (-1 none)
+    acc_value: jax.Array  # int32[N] accepted value (0 none)
+    # proposer (volatile)
+    phase: jax.Array  # int32[N]
+    ballot: jax.Array  # int32[N] current ballot
+    round: jax.Array  # int32[N] retry round counter (durable would also be fine)
+    promises: jax.Array  # int32[N] promise count this ballot
+    best_ballot: jax.Array  # int32[N] highest accepted ballot among promises
+    best_value: jax.Array  # int32[N] its value
+    accepts: jax.Array  # int32[N] ACCEPTED count this ballot
+    decided: jax.Array  # bool[N]
+    # ghost chosen-register (spec-only, row 0)
+    chosen_any: jax.Array  # bool[N]
+    chosen_val: jax.Array  # int32[N]
+    bad: jax.Array  # bool[N]
+
+
+class PaxosMachine(Machine):
+    PAYLOAD_WIDTH = 5
+    MAX_TIMERS = 2
+    NUM_PROPOSERS = 2
+
+    def __init__(self, num_nodes: int = 5):
+        self.NUM_NODES = num_nodes
+        self.MAX_MSGS = num_nodes - 1
+        self.majority = num_nodes // 2 + 1
+
+    def init(self, rng_key) -> PaxosState:
+        n = self.NUM_NODES
+        z = jnp.zeros((n,), jnp.int32)
+        neg = jnp.full((n,), -1, jnp.int32)
+        return PaxosState(
+            promised=neg,
+            acc_ballot=neg,
+            acc_value=z,
+            phase=z,
+            ballot=neg,
+            round=z,
+            promises=z,
+            best_ballot=neg,
+            best_value=z,
+            accepts=z,
+            decided=jnp.zeros((n,), bool),
+            chosen_any=jnp.zeros((n,), bool),
+            chosen_val=z,
+            bad=jnp.zeros((n,), bool),
+        )
+
+    def restart_if(self, nodes: PaxosState, i, cond, rng_key) -> PaxosState:
+        """Kill/restart: acceptor state is stable storage; the proposer
+        side restarts idle (it will re-propose from its round counter,
+        which also survives — a fresh higher ballot, like a real
+        proposer recovering its ballot from disk)."""
+        n = self.NUM_NODES
+        row = (jnp.arange(n) == i) & cond
+        set_row = lambda arr, v: jnp.where(row, v, arr)  # noqa: E731
+        return nodes.replace(
+            phase=set_row(nodes.phase, IDLE),
+            ballot=set_row(nodes.ballot, -1),
+            promises=set_row(nodes.promises, 0),
+            best_ballot=set_row(nodes.best_ballot, -1),
+            best_value=set_row(nodes.best_value, 0),
+            accepts=set_row(nodes.accepts, 0),
+            decided=jnp.where(row, False, nodes.decided),
+        )
+
+    def _is_proposer(self, node):
+        return node < self.NUM_PROPOSERS
+
+    def _my_value(self, node):
+        return node + jnp.int32(1)  # distinct non-zero proposal values
+
+    def _accept_guard(self, nodes: PaxosState, node, b) -> jax.Array:
+        """Acceptor's ballot check on ACCEPT — the line the bug variant
+        drops (accepting stale ballots breaks agreement)."""
+        return b >= nodes.promised[node]
+
+    # -- phase helpers (shared by timer + message handlers) ------------------
+
+    def _start_prepare(self, nodes: PaxosState, node, outbox: Outbox, cond) -> Tuple[PaxosState, Outbox]:
+        """Begin a new ballot: self-promise + broadcast PREPARE. The
+        round jumps past whatever our own acceptor already promised, so
+        the new ballot is always self-promisable (otherwise a proposer
+        whose acceptor promised a rival's higher ballot would retry the
+        same dead ballot forever)."""
+        n = self.NUM_NODES
+        round_eff = jnp.maximum(
+            nodes.round[node], (nodes.promised[node] - node) // n + 1
+        )
+        new_ballot = round_eff * n + node
+        nodes = update_node(
+            nodes, node,
+            phase=jnp.where(cond, PREPARING, nodes.phase[node]),
+            ballot=jnp.where(cond, new_ballot, nodes.ballot[node]),
+            round=jnp.where(cond, round_eff + 1, nodes.round[node]),
+            promises=jnp.where(cond, 1, nodes.promises[node]),
+            best_ballot=jnp.where(cond, nodes.acc_ballot[node], nodes.best_ballot[node]),
+            best_value=jnp.where(cond, nodes.acc_value[node], nodes.best_value[node]),
+            accepts=jnp.where(cond, 0, nodes.accepts[node]),
+        )
+        nodes = nodes.replace(promised=jnp.where(
+            cond, set_at(nodes.promised, node, new_ballot), nodes.promised
+        ))
+        prepare = make_payload(self.PAYLOAD_WIDTH, M_PREPARE, new_ballot)
+        peers = (node + jnp.arange(1, n, dtype=jnp.int32)) % n
+        for s in range(self.MAX_MSGS):
+            outbox = send_if(outbox, s, cond, peers[s], prepare)
+        return nodes, outbox
+
+    # -- timers ---------------------------------------------------------------
+
+    def on_timer(self, nodes: PaxosState, node, timer_id, now_us, rand_u32) -> Tuple[PaxosState, Outbox]:
+        outbox = self.empty_outbox()
+        is_boot = timer_id == T_BOOT
+        is_prop = self._is_proposer(node)
+
+        delay = jnp.int32(PROPOSE_MIN_US) + (
+            rand_u32[0] % jnp.uint32(PROPOSE_SPAN_US)
+        ).astype(jnp.int32)
+        outbox = set_timer_if(outbox, 0, is_boot & is_prop, delay, T_PROPOSE)
+
+        fire = (timer_id == T_PROPOSE) | (timer_id == T_RETRY)
+        start = fire & is_prop & ~nodes.decided[node]
+        nodes, outbox = self._start_prepare(nodes, node, outbox, start)
+        # retry timer: if still undecided later, go again with higher ballot
+        retry_delay = jnp.int32(RETRY_MIN_US) + (
+            rand_u32[1] % jnp.uint32(RETRY_SPAN_US)
+        ).astype(jnp.int32)
+        outbox = set_timer_if(outbox, 1, fire & is_prop, retry_delay, T_RETRY)
+        return nodes, outbox
+
+    # -- messages -------------------------------------------------------------
+
+    def on_message(self, nodes: PaxosState, node, src, payload, now_us, rand_u32) -> Tuple[PaxosState, Outbox]:
+        outbox = self.empty_outbox()
+        mtype = payload[0]
+        n = self.NUM_NODES
+
+        # ---- acceptor: PREPARE -> PROMISE or NACK ----
+        is_prep = mtype == M_PREPARE
+        b = payload[1]
+        grant = is_prep & (b > nodes.promised[node])
+        nodes = nodes.replace(promised=jnp.where(
+            grant, set_at(nodes.promised, node, b), nodes.promised
+        ))
+        promise = make_payload(
+            self.PAYLOAD_WIDTH, M_PROMISE, b, nodes.acc_ballot[node], nodes.acc_value[node]
+        )
+        outbox = send_if(outbox, 0, grant, src, promise)
+        nack = make_payload(self.PAYLOAD_WIDTH, M_NACK, b)
+        outbox = send_if(outbox, 0, is_prep & ~grant, src, nack)
+
+        # ---- proposer: PROMISE ----
+        is_promise = (mtype == M_PROMISE) & self._is_proposer(node)
+        p_b, p_accb, p_accv = payload[1], payload[2], payload[3]
+        counts = is_promise & (nodes.phase[node] == PREPARING) & (p_b == nodes.ballot[node])
+        better = counts & (p_accb > nodes.best_ballot[node])
+        new_promises = nodes.promises[node] + jnp.where(counts, 1, 0)
+        nodes = update_node(
+            nodes, node,
+            promises=new_promises,
+            best_ballot=jnp.where(better, p_accb, nodes.best_ballot[node]),
+            best_value=jnp.where(better, p_accv, nodes.best_value[node]),
+        )
+        quorum = counts & (new_promises >= self.majority)
+        # constrained choice: highest accepted value among promises, else own
+        value = jnp.where(nodes.best_ballot[node] >= 0, nodes.best_value[node], self._my_value(node))
+        # self-accept (own acceptor, guard applies)
+        self_ok = quorum & self._accept_guard(nodes, node, nodes.ballot[node])
+        nodes = update_node(
+            nodes, node,
+            phase=jnp.where(quorum, ACCEPTING, nodes.phase[node]),
+            accepts=jnp.where(quorum, jnp.where(self_ok, 1, 0), nodes.accepts[node]),
+        )
+        nodes = nodes.replace(
+            acc_ballot=jnp.where(self_ok, set_at(nodes.acc_ballot, node, nodes.ballot[node]), nodes.acc_ballot),
+            acc_value=jnp.where(self_ok, set_at(nodes.acc_value, node, value), nodes.acc_value),
+        )
+        accept = make_payload(self.PAYLOAD_WIDTH, M_ACCEPT, nodes.ballot[node], value)
+        peers = (node + jnp.arange(1, n, dtype=jnp.int32)) % n
+        for s in range(self.MAX_MSGS):
+            outbox = send_if(outbox, s, quorum, peers[s], accept)
+
+        # ---- acceptor: ACCEPT -> ACCEPTED or NACK ----
+        is_acc = mtype == M_ACCEPT
+        a_b, a_v = payload[1], payload[2]
+        take = is_acc & self._accept_guard(nodes, node, a_b)
+        nodes = nodes.replace(
+            promised=jnp.where(take, set_at(nodes.promised, node, jnp.maximum(a_b, nodes.promised[node])), nodes.promised),
+            acc_ballot=jnp.where(take, set_at(nodes.acc_ballot, node, a_b), nodes.acc_ballot),
+            acc_value=jnp.where(take, set_at(nodes.acc_value, node, a_v), nodes.acc_value),
+        )
+        accepted = make_payload(self.PAYLOAD_WIDTH, M_ACCEPTED, a_b, a_v)
+        outbox = send_if(outbox, 0, take, src, accepted)
+
+        # ---- proposer: ACCEPTED -> chosen on majority ----
+        is_acked = (mtype == M_ACCEPTED) & self._is_proposer(node)
+        k_b, k_v = payload[1], payload[2]
+        counts2 = is_acked & (nodes.phase[node] == ACCEPTING) & (k_b == nodes.ballot[node])
+        new_accepts = nodes.accepts[node] + jnp.where(counts2, 1, 0)
+        chosen = counts2 & (new_accepts >= self.majority)
+        nodes = update_node(
+            nodes, node,
+            accepts=new_accepts,
+            phase=jnp.where(chosen, DECIDED, nodes.phase[node]),
+            decided=nodes.decided[node] | chosen,
+        )
+        # ghost chosen-register on row 0 (agreement check)
+        conflict = chosen & nodes.chosen_any[0] & (nodes.chosen_val[0] != k_v)
+        first = chosen & ~nodes.chosen_any[0]
+        nodes = nodes.replace(
+            chosen_any=jnp.where(first, set_at(nodes.chosen_any, 0, True), nodes.chosen_any),
+            chosen_val=jnp.where(first, set_at(nodes.chosen_val, 0, k_v), nodes.chosen_val),
+            bad=jnp.where(conflict, set_at(nodes.bad, 0, True), nodes.bad),
+        )
+        return nodes, outbox
+
+    # -- invariants / results --------------------------------------------------
+
+    def invariant(self, nodes: PaxosState, now_us):
+        ok = ~nodes.bad[0]
+        return ok, jnp.where(ok, 0, AGREEMENT).astype(jnp.int32)
+
+    def is_done(self, nodes: PaxosState, now_us):
+        return jnp.all(nodes.decided[: self.NUM_PROPOSERS])
+
+    def summary(self, nodes: PaxosState):
+        return {
+            "chosen": nodes.chosen_any[0],
+            "value": nodes.chosen_val[0],
+            "rounds": nodes.round[: self.NUM_PROPOSERS].max(),
+        }
+
+
+class NoPromiseCheckPaxos(PaxosMachine):
+    """Bug variant: acceptors accept any ACCEPT regardless of promised
+    ballot — under dueling proposers + partitions, two distinct values
+    get majority-accepted and AGREEMENT trips."""
+
+    def _accept_guard(self, nodes: PaxosState, node, b) -> jax.Array:
+        return jnp.bool_(True)
