@@ -35,7 +35,9 @@ def shard_seeds(seeds, mesh: Mesh):
     engine's whole state inherits the lane sharding by propagation.
 
     Validates the mesh and batch shape up front so every sharding entry
-    point gets a clear error instead of a raw XLA one."""
+    point gets a clear error instead of a raw XLA one. On a multi-host
+    (jax.distributed) mesh, each process materializes only its local
+    shard — device_put can't place onto non-addressable devices."""
     if SEED_AXIS not in mesh.shape:
         raise ValueError(
             f'mesh has no "{SEED_AXIS}" axis (axes: {tuple(mesh.shape)}); '
@@ -48,7 +50,11 @@ def shard_seeds(seeds, mesh: Mesh):
             f"seed batch ({n}) must be a multiple of the mesh's "
             f'"{SEED_AXIS}" axis size ({axis})'
         )
-    return jax.device_put(seeds, seed_sharding(mesh))
+    sharding = seed_sharding(mesh)
+    if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
+        host = np.asarray(seeds)
+        return jax.make_array_from_callback(host.shape, sharding, lambda idx: host[idx])
+    return jax.device_put(seeds, sharding)
 
 
 def pad_to_multiple(n: int, k: int) -> int:

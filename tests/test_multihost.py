@@ -34,6 +34,15 @@ WORKER = textwrap.dedent(
     out = multihost.run_batch_global(eng, 32, seed_start=10, max_steps=400)
     print("RESULT", out["processes"], out["global_devices"],
           out["completed"], out["failed"], flush=True)
+
+    # streaming path over the same global mesh: every process runs the
+    # identical SPMD loop; counters/rings come back replicated
+    stream = eng.run_stream(
+        64, batch=16, segment_steps=64, seed_start=100, max_steps=400,
+        mesh=multihost.global_mesh(),
+    )
+    print("STREAM", stream["completed"], len(stream["failing"]),
+          stream["seeds_consumed"], flush=True)
     """
 ).format(repo=REPO)
 
@@ -79,3 +88,36 @@ def test_two_process_global_batch():
     _tag, nprocs, ndev, completed, failed = results[0]
     assert (nprocs, ndev) == ("2", "8")
     assert int(completed) == 32 and int(failed) == 0
+
+
+def test_two_process_streaming():
+    # covered by the same workers (they print a STREAM line after RESULT)
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            MADSIM_TPU_COORDINATOR=f"127.0.0.1:{port}",
+            MADSIM_TPU_NUM_PROCS="2",
+            MADSIM_TPU_PROC_ID=str(pid),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", WORKER],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+        )
+    lines = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        stream = [ln for ln in out.splitlines() if ln.startswith("STREAM")]
+        assert stream, f"no STREAM line:\n{out}\n{err}"
+        lines.append(stream[0].split())
+    # identical replicated results on both processes; all 64 seeds done
+    assert lines[0] == lines[1]
+    _tag, completed, n_fail, consumed = lines[0]
+    assert int(completed) >= 64 and int(n_fail) == 0 and int(consumed) >= 64
