@@ -161,6 +161,7 @@ class RaftNode:
         if acks > len(self.peers) // 2:
             self.commit = len(self.log) - 1
             self.state["max_commit"] = max(self.state.get("max_commit", 0), self.commit)
+            self.state.setdefault("commits", {})[self.me] = self.commit
 
     async def on_request_vote(self, req: RequestVote, data):
         if req.term > self.term:
@@ -189,6 +190,9 @@ class RaftNode:
             self.log = self.log[: req.prev_idx + 1] + list(req.entries)
             self.persist()
         self.commit = min(req.commit, len(self.log) - 1)
+        self.state.setdefault("commits", {})[self.me] = max(
+            self.state.setdefault("commits", {}).get(self.me, 0), self.commit
+        )
         return {"term": self.term, "ok": True}
 
 

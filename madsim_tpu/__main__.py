@@ -29,9 +29,11 @@ def build_machine(name: str, nodes: int = 0):
     writing a protocol first."""
     from .models.echo import EchoMachine
     from .models.etcd import EtcdMachine
+    from .models.etcd_mvcc import EtcdMvccMachine
     from .models.kafka_group import KafkaGroupMachine, NoFencingGroupMachine
     from .models.kv import KvMachine
     from .models.mq import MqMachine
+    from .models.multipaxos import MultiPaxosMachine, NoPromiseCheckMultiPaxos
     from .models.paxos import NoPromiseCheckPaxos, PaxosMachine
     from .models.raft import RaftMachine
     from .models.twopc import TwoPcMachine
@@ -42,15 +44,23 @@ def build_machine(name: str, nodes: int = 0):
     class OvercommitRaft(RaftMachine):
         COMMIT_TO_LOG_LEN = True  # Raft §5.3 commit-bound bug
 
+    class QuorumOffByOneRaft(RaftMachine):
+        QUORUM_OFF_BY_ONE = True  # commit below majority (needs group faults)
+
+    class NoDedupMvcc(EtcdMvccMachine):
+        NO_DEDUP = True  # retransmits double-apply (needs storms/dir clogs)
+
     machines = {
         "echo": lambda: EchoMachine(rounds=10),
         "raft": lambda: RaftMachine(num_nodes=nodes or 5, log_capacity=8),
         "kv": lambda: KvMachine(num_nodes=nodes or 4),
         "mq": lambda: MqMachine(num_nodes=nodes or 4),
         "etcd": lambda: EtcdMachine(num_nodes=nodes or 4),
+        "etcd-mvcc": lambda: EtcdMvccMachine(num_nodes=nodes or 4),
         "twopc": lambda: TwoPcMachine(num_nodes=nodes or 4),
         "group": lambda: KafkaGroupMachine(num_nodes=nodes or 4),
         "paxos": lambda: PaxosMachine(num_nodes=nodes or 5),
+        "multipaxos": lambda: MultiPaxosMachine(num_nodes=nodes or 5),
         "demo-nopromise-paxos": lambda: NoPromiseCheckPaxos(num_nodes=nodes or 5),
         "demo-doublegrant-etcd": lambda: DoubleGrantEtcd(
             num_nodes=nodes or 4, target_gens=99, target_writes=9999
@@ -59,6 +69,13 @@ def build_machine(name: str, nodes: int = 0):
             num_nodes=nodes or 5, log_capacity=8
         ),
         "demo-nofencing-group": lambda: NoFencingGroupMachine(num_nodes=nodes or 4),
+        "demo-quorumoffbyone-raft": lambda: QuorumOffByOneRaft(
+            num_nodes=nodes or 5, log_capacity=8
+        ),
+        "demo-nodedup-mvcc": lambda: NoDedupMvcc(num_nodes=nodes or 4),
+        "demo-nopromise-multipaxos": lambda: NoPromiseCheckMultiPaxos(
+            num_nodes=nodes or 5
+        ),
     }
     if name not in machines:
         sys.exit(f"unknown machine {name!r}; choose from {sorted(machines)}")
@@ -82,9 +99,27 @@ def _build_engine(args):
             t_max_us=args.fault_tmax or int(args.horizon * 0.6e6) or 1,
             dur_min_us=100_000,
             dur_max_us=800_000,
+            **_fault_kind_flags(args),
         ),
     )
     return Engine(machine, cfg)
+
+
+def _fault_kind_flags(args) -> dict:
+    # default-tolerant: programmatic callers and pre-round-3 recorded
+    # argsets may lack the flag; absent == legacy pair,kill
+    raw = getattr(args, "fault_kinds", "pair,kill")
+    kinds = {k.strip() for k in raw.split(",") if k.strip()}
+    known = {"pair", "kill", "dir", "group", "storm"}
+    if not kinds <= known:
+        sys.exit(f"unknown fault kinds {sorted(kinds - known)}; choose from {sorted(known)}")
+    return {
+        "allow_partition": "pair" in kinds,
+        "allow_kill": "kill" in kinds,
+        "allow_dir_clog": "dir" in kinds,
+        "allow_group": "group" in kinds,
+        "allow_storm": "storm" in kinds,
+    }
 
 
 def _repro_line(args, seed) -> str:
@@ -96,7 +131,9 @@ def _repro_line(args, seed) -> str:
         f"reproduce: python -m madsim_tpu replay --machine {args.machine} "
         f"--seed {seed} --nodes {args.nodes} --horizon {args.horizon} "
         f"--queue {args.queue} --faults {args.faults} --loss {args.loss} "
-        f"--fault-tmax {tmax} --max-steps {args.max_steps}"
+        f"--fault-tmax {tmax} "
+        f"--fault-kinds {getattr(args, 'fault_kinds', 'pair,kill')} "
+        f"--max-steps {args.max_steps}"
     )
 
 
@@ -403,6 +440,12 @@ def main(argv=None) -> int:
         p.add_argument(
             "--fault-tmax", type=int, default=0,
             help="fault injection window in us (0 = 60%% of horizon)",
+        )
+        p.add_argument(
+            "--fault-kinds", default="pair,kill",
+            help="comma list of fault kinds to draw from: "
+            "pair,kill,dir,group,storm (default pair,kill; any other "
+            "kind switches to the v2 schedule derivation)",
         )
 
     p = sub.add_parser("explore", help="run a seed batch, report failing seeds")

@@ -46,11 +46,25 @@ EV_TIMER = 0
 EV_MSG = 1
 EV_FAULT = 2
 
-# Fault ops (payload[0])
+# Fault ops (payload[0]). Apply ops are even, the matching undo is
+# apply+1, and apply = 2*kind where kind indexes FaultPlan.enabled_kinds.
 F_CLOG_PAIR = 0
 F_UNCLOG_PAIR = 1
 F_KILL = 2
 F_RESTART = 3
+F_CLOG_DIR = 4  # one-way clog a->b (reference Direction, sim/net/network.rs:108)
+F_UNCLOG_DIR = 5
+F_CLOG_GROUP = 6  # group partition: payload[1] is a node bitmask; every
+F_UNCLOG_GROUP = 7  # link crossing the group boundary clogs both ways
+F_LOSS_STORM = 8  # timed packet-loss storm: payload[1] = rate in 1/65536
+F_LOSS_END = 9
+
+# FaultPlan kind indices (op_apply = 2*kind)
+K_PAIR = 0
+K_KILL = 1
+K_DIR = 2
+K_GROUP = 3
+K_STORM = 4
 
 # Failure codes
 OK = 0
@@ -64,15 +78,48 @@ class FaultPlan:
     Each fault picks a random kind, start time and duration:
       * partition: clog a random node pair both ways, heal after duration
       * kill: kill a random node, restart after duration
+      * dir_clog: clog one direction of a random pair (the host fabric's
+        `Direction` semantics, reference sim/net/network.rs:108)
+      * group: partition a random non-trivial node subset from the rest
+        (covers majority/minority splits; bitmask-encoded)
+      * storm: raise the packet-loss rate to `storm_loss_u16`/65536 for
+        the duration (timed loss storm on top of the static config rate)
+
+    The legacy two-kind derivation (partition/kill only) is byte-stable:
+    seeds found by earlier sweeps (e.g. the 66531 LOG_MATCHING
+    regression) replay unchanged unless a new kind is enabled, which
+    switches the schedule to the v2 derivation.
     """
 
     n_faults: int = 0
     allow_partition: bool = True
     allow_kill: bool = True
+    allow_dir_clog: bool = False
+    allow_group: bool = False
+    allow_storm: bool = False
+    storm_loss_u16: int = 52428  # ~80% loss while a storm is active
     t_min_us: int = 0
     t_max_us: int = 1_000_000
     dur_min_us: int = 100_000
     dur_max_us: int = 1_000_000
+
+    def enabled_kinds(self) -> tuple:
+        kinds = []
+        if self.allow_partition:
+            kinds.append(K_PAIR)
+        if self.allow_kill:
+            kinds.append(K_KILL)
+        if self.allow_dir_clog:
+            kinds.append(K_DIR)
+        if self.allow_group:
+            kinds.append(K_GROUP)
+        if self.allow_storm:
+            kinds.append(K_STORM)
+        return tuple(kinds)
+
+    @property
+    def uses_v2_kinds(self) -> bool:
+        return self.allow_dir_clog or self.allow_group or self.allow_storm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,6 +151,7 @@ class LaneState:
     fail_code: jax.Array
     horizon_hit: jax.Array
     msg_count: jax.Array
+    storm_loss: jax.Array  # int32: active storm loss rate in 1/65536 (0 = none)
     eq_time: jax.Array  # int32[Q]
     eq_seq: jax.Array  # int32[Q]
     eq_kind: jax.Array  # int32[Q]
@@ -168,6 +216,13 @@ class Engine:
                 f"queue_capacity={q} too small for {n} nodes + "
                 f"{config.faults.n_faults} faults + outbox headroom"
             )
+        fp = config.faults
+        if fp.n_faults > 0 and not fp.enabled_kinds():
+            raise ValueError("FaultPlan has n_faults > 0 but every kind disabled")
+        if fp.allow_group and (n < 2 or n > 30):
+            raise ValueError("group partitions need 2 <= NUM_NODES <= 30 (int32 bitmask)")
+        if not 0 <= fp.storm_loss_u16 <= 65535:
+            raise ValueError("storm_loss_u16 must be in [0, 65535]")
 
     # -- lane init -----------------------------------------------------------
 
@@ -194,24 +249,61 @@ class Engine:
         # Fault schedule: apply + undo event per fault, slots [n, n+2F).
         fp = cfg.faults
         for f in range(fp.n_faults):
-            k_faults, k1, k2, k3, k4, k5 = jax.random.split(k_faults, 6)
-            t = jnp.int32(fp.t_min_us) + (
-                jax.random.bits(k1, (), jnp.uint32) % jnp.uint32(fp.t_max_us - fp.t_min_us)
-            ).astype(jnp.int32)
-            dur = jnp.int32(fp.dur_min_us) + (
-                jax.random.bits(k2, (), jnp.uint32) % jnp.uint32(fp.dur_max_us - fp.dur_min_us)
-            ).astype(jnp.int32)
-            a = (jax.random.bits(k3, (), jnp.uint32) % jnp.uint32(n)).astype(jnp.int32)
-            b_off = 1 + (jax.random.bits(k4, (), jnp.uint32) % jnp.uint32(n - 1)).astype(jnp.int32)
-            b = (a + b_off) % n
-            if fp.allow_partition and fp.allow_kill:
-                is_part = (jax.random.bits(k5, (), jnp.uint32) % 2) == 0
-            elif fp.allow_partition:
-                is_part = jnp.bool_(True)
+            if not fp.uses_v2_kinds:
+                # v1 derivation (partition/kill) — byte-stable for replay
+                # of historically found seeds
+                k_faults, k1, k2, k3, k4, k5 = jax.random.split(k_faults, 6)
+                t = jnp.int32(fp.t_min_us) + (
+                    jax.random.bits(k1, (), jnp.uint32) % jnp.uint32(fp.t_max_us - fp.t_min_us)
+                ).astype(jnp.int32)
+                dur = jnp.int32(fp.dur_min_us) + (
+                    jax.random.bits(k2, (), jnp.uint32) % jnp.uint32(fp.dur_max_us - fp.dur_min_us)
+                ).astype(jnp.int32)
+                a = (jax.random.bits(k3, (), jnp.uint32) % jnp.uint32(n)).astype(jnp.int32)
+                b_off = 1 + (jax.random.bits(k4, (), jnp.uint32) % jnp.uint32(n - 1)).astype(
+                    jnp.int32
+                )
+                b = (a + b_off) % n
+                if fp.allow_partition and fp.allow_kill:
+                    is_part = (jax.random.bits(k5, (), jnp.uint32) % 2) == 0
+                elif fp.allow_partition:
+                    is_part = jnp.bool_(True)
+                else:
+                    is_part = jnp.bool_(False)
+                op_apply = jnp.where(is_part, F_CLOG_PAIR, F_KILL).astype(jnp.int32)
+                op_undo = jnp.where(is_part, F_UNCLOG_PAIR, F_RESTART).astype(jnp.int32)
+                arg1, arg2 = a, b
             else:
-                is_part = jnp.bool_(False)
-            op_apply = jnp.where(is_part, F_CLOG_PAIR, F_KILL).astype(jnp.int32)
-            op_undo = jnp.where(is_part, F_UNCLOG_PAIR, F_RESTART).astype(jnp.int32)
+                # v2 derivation: uniform over the enabled kind set; every
+                # argument is drawn unconditionally (constant draw count
+                # keeps the schedule stable under config flag flips that
+                # don't change the kind list)
+                k_faults, k1, k2, k3, k4, k5, k6 = jax.random.split(k_faults, 7)
+                t = jnp.int32(fp.t_min_us) + (
+                    jax.random.bits(k1, (), jnp.uint32) % jnp.uint32(fp.t_max_us - fp.t_min_us)
+                ).astype(jnp.int32)
+                dur = jnp.int32(fp.dur_min_us) + (
+                    jax.random.bits(k2, (), jnp.uint32) % jnp.uint32(fp.dur_max_us - fp.dur_min_us)
+                ).astype(jnp.int32)
+                a = (jax.random.bits(k3, (), jnp.uint32) % jnp.uint32(n)).astype(jnp.int32)
+                b_off = 1 + (jax.random.bits(k4, (), jnp.uint32) % jnp.uint32(n - 1)).astype(
+                    jnp.int32
+                )
+                b = (a + b_off) % n
+                kinds = jnp.asarray(fp.enabled_kinds(), jnp.int32)
+                kind = kinds[jax.random.bits(k5, (), jnp.uint32) % jnp.uint32(len(kinds))]
+                # non-trivial bitmask: at least one node on each side
+                mask = 1 + (
+                    jax.random.bits(k6, (), jnp.uint32) % jnp.uint32(2**n - 2)
+                ).astype(jnp.int32)
+                op_apply = (2 * kind).astype(jnp.int32)
+                op_undo = (2 * kind + 1).astype(jnp.int32)
+                arg1 = jnp.where(
+                    kind == K_GROUP,
+                    mask,
+                    jnp.where(kind == K_STORM, jnp.int32(fp.storm_loss_u16), a),
+                )
+                arg2 = b
             for slot_off, (tt, op) in enumerate([(t, op_apply), (t + dur, op_undo)]):
                 i = n + 2 * f + slot_off
                 msk = slots == i
@@ -219,7 +311,7 @@ class Engine:
                 eq_seq = jnp.where(msk, next_seq + slot_off, eq_seq)
                 eq_kind = jnp.where(msk, EV_FAULT, eq_kind)
                 eq_node = jnp.where(msk, a, eq_node)
-                pay = jnp.stack([op, a, b] + [jnp.int32(0)] * (p - 3))
+                pay = jnp.stack([op, arg1, arg2] + [jnp.int32(0)] * (p - 3))
                 eq_payload = jnp.where(msk[:, None], pay[None, :], eq_payload)
                 eq_valid = eq_valid | msk
             next_seq += 2
@@ -234,6 +326,7 @@ class Engine:
             fail_code=jnp.int32(OK),
             horizon_hit=jnp.bool_(False),
             msg_count=jnp.int32(0),
+            storm_loss=jnp.int32(0),
             eq_time=eq_time,
             eq_seq=eq_seq,
             eq_kind=eq_kind,
@@ -310,36 +403,54 @@ class Engine:
 
         def timer_branch(_):
             nodes, outbox = m.on_timer(s.nodes, ev_node, ev_payload[0], new_now, rand_u32)
-            return nodes, outbox, s.clogged, s.killed, jnp.int32(-1)
+            return nodes, outbox, s.clogged, s.killed, s.storm_loss, jnp.int32(-1)
 
         def msg_branch(_):
             nodes, outbox = m.on_message(s.nodes, ev_node, ev_src, ev_payload, new_now, rand_u32)
-            return nodes, outbox, s.clogged, s.killed, jnp.int32(-1)
+            return nodes, outbox, s.clogged, s.killed, s.storm_loss, jnp.int32(-1)
 
         def fault_branch(_):
             op, a, b = ev_payload[0], ev_payload[1], ev_payload[2]
-            clog_val = op == F_CLOG_PAIR
-            touch_clog = (op == F_CLOG_PAIR) | (op == F_UNCLOG_PAIR)
+            nn = s.killed.shape[0]
+            # pair partition: both directions
+            pair_val = op == F_CLOG_PAIR
+            touch_pair = (op == F_CLOG_PAIR) | (op == F_UNCLOG_PAIR)
             clogged = jnp.where(
-                touch_clog,
-                set2d(set2d(s.clogged, a, b, clog_val), b, a, clog_val),
+                touch_pair,
+                set2d(set2d(s.clogged, a, b, pair_val), b, a, pair_val),
                 s.clogged,
             )
-            a_mask = jnp.arange(s.killed.shape[0]) == a
+            # directional clog: a->b only (Direction parity, network.rs:108)
+            dir_val = op == F_CLOG_DIR
+            touch_dir = (op == F_CLOG_DIR) | (op == F_UNCLOG_DIR)
+            clogged = jnp.where(touch_dir, set2d(clogged, a, b, dir_val), clogged)
+            # group partition: `a` is a node bitmask; clog/heal every link
+            # crossing the group boundary (covers majority/minority splits)
+            in_g = ((a >> jnp.arange(nn)) & 1).astype(bool)
+            cross = in_g[:, None] != in_g[None, :]
+            touch_group = (op == F_CLOG_GROUP) | (op == F_UNCLOG_GROUP)
+            clogged = jnp.where(touch_group & cross, op == F_CLOG_GROUP, clogged)
+            a_mask = jnp.arange(nn) == a
             killed = jnp.where(
                 op == F_KILL,
                 s.killed | a_mask,
                 jnp.where(op == F_RESTART, s.killed & ~a_mask, s.killed),
             )
+            # loss storm: `a` is the storm rate in 1/65536 units
+            storm = jnp.where(
+                op == F_LOSS_STORM,
+                a,
+                jnp.where(op == F_LOSS_END, jnp.int32(0), s.storm_loss),
+            ).astype(jnp.int32)
             # cond folded into the machine's own row masks — no full-tree
             # select here (XLA CSEs it inside the fused loop, but eager
             # step_batch paid ~30% for it, and masked writes are strictly
             # less work for any backend)
             nodes = m.restart_node_if(s.nodes, a, op == F_RESTART, k_restart)
             boot_node = jnp.where(op == F_RESTART, a, jnp.int32(-1))
-            return nodes, m.empty_outbox(), clogged, killed, boot_node
+            return nodes, m.empty_outbox(), clogged, killed, storm, boot_node
 
-        nodes, outbox, clogged, killed, boot_node = lax.switch(
+        nodes, outbox, clogged, killed, storm_loss, boot_node = lax.switch(
             ev_kind, [timer_branch, msg_branch, fault_branch], None
         )
 
@@ -350,6 +461,7 @@ class Engine:
         nodes = tree_where(effective, nodes, s.nodes)
         clogged = jnp.where(effective, clogged, s.clogged)
         killed = jnp.where(effective, killed, s.killed)
+        storm_loss = jnp.where(effective, storm_loss, s.storm_loss)
         outbox_valid_msgs = outbox.msg_valid & effective
         outbox_valid_timers = outbox.timer_valid & effective
 
@@ -371,7 +483,12 @@ class Engine:
         lat_span = max(1, cfg.latency_max_us - cfg.latency_min_us)
         lat_bits = step_words[cfg.handler_rand_words : cfg.handler_rand_words + m.MAX_MSGS]
         drop_bits = step_words[cfg.handler_rand_words + m.MAX_MSGS :]
-        loss_threshold = jnp.uint32(int(cfg.packet_loss_rate * 0xFFFFFFFF))
+        # static config loss + active storm (storm rate 65535 ~= drop all),
+        # saturating at u32 max
+        base_threshold = jnp.uint32(int(cfg.packet_loss_rate * 0xFFFFFFFF))
+        storm_threshold = storm_loss.astype(jnp.uint32) * jnp.uint32(65537)
+        summed = base_threshold + storm_threshold
+        loss_threshold = jnp.where(summed < storm_threshold, jnp.uint32(0xFFFFFFFF), summed)
 
         for mi in range(m.MAX_MSGS):
             want = outbox_valid_msgs[mi]
@@ -435,6 +552,7 @@ class Engine:
             fail_code=fail_code,
             horizon_hit=s.horizon_hit | horizon_hit,
             msg_count=msg_count,
+            storm_loss=storm_loss,
             eq_time=eq["time"],
             eq_seq=eq["seq"],
             eq_kind=eq["kind"],

@@ -18,8 +18,18 @@ timeouts and fenced commits; at-least-once + no-commit-regression
 invariants (the rdkafka consumer-group workload, batched).
 `paxos` — single-decree Paxos with durable acceptors and dueling
 proposers; agreement invariant via a ghost chosen-register.
+`multipaxos` — multi-decree Paxos: a log of synod slots driven by
+dueling proposers with LEARN propagation; per-slot agreement + learned-
+log-consistency invariants (the second consensus family at MadRaft
+depth).
+`etcd_mvcc` — MVCC etcd server (revisions, txns, leases with ghost
+expiry) + retrying clients; revision-accounting, txn-atomicity,
+lease-expiry-safety and exactly-once invariants.
 """
 
-from . import echo, etcd, kafka_group, kv, mq, paxos, raft, twopc
+from . import echo, etcd, etcd_mvcc, kafka_group, kv, mq, multipaxos, paxos, raft, twopc
 
-__all__ = ["echo", "etcd", "kafka_group", "kv", "mq", "paxos", "raft", "twopc"]
+__all__ = [
+    "echo", "etcd", "etcd_mvcc", "kafka_group", "kv", "mq", "multipaxos",
+    "paxos", "raft", "twopc",
+]

@@ -1,0 +1,368 @@
+"""Multi-decree Paxos — a replicated LOG of synod instances, batched.
+
+Round-3 answer to "single-decree only" (VERDICT r2 weak #5 / item 8):
+the second consensus family at MadRaft depth. Every node is an acceptor
+with durable per-slot (promised, accepted) state; nodes 0 and 1 are
+proposers that drive a fixed log of `log_slots` decrees, one synod per
+slot, racing each other under partitions / kills / storms. A proposer
+that gets a slot chosen broadcasts LEARN and immediately moves to its
+next unlearned slot (a short T_NEXT timer), so the log fills at RTT
+pace while the rival's retries contend for the same slots with
+ever-higher ballots — the leader-change dynamic the chaos schedule
+stresses.
+
+Invariants:
+  * AGREEMENT_MULTI (150): at most one value ever chosen per slot —
+    ghost per-slot chosen registers on row 0, written when a proposer
+    observes a majority of ACCEPTED acks, never read by the protocol.
+  * LEARN_DIVERGED (151): a node learned a value for a slot that
+    differs from the slot's ghost chosen value (a broken learn path
+    would let state machines execute divergent logs).
+
+`NoPromiseCheckMultiPaxos` drops the acceptor's ballot guard on ACCEPT
+(same classic bug as the single-decree variant) — under dueling
+proposers + chaos, two values get majority-accepted in one slot.
+
+Reference scenario family: consensus-under-chaos at MadRaft depth
+(BASELINE.json workloads); single-decree sibling: models/paxos.py.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..engine.machine import (
+    Machine,
+    Outbox,
+    make_payload,
+    send_if,
+    set_timer_if,
+    update_node,
+)
+from ..utils import set2d
+
+# messages: [mtype, slot, b, v/acc_b, acc_v]
+M_PREPARE, M_PROMISE, M_ACCEPT, M_ACCEPTED, M_NACK, M_LEARN = 1, 2, 3, 4, 5, 6
+
+# timers
+T_BOOT, T_PROPOSE, T_RETRY, T_NEXT = 0, 1, 2, 3
+
+AGREEMENT_MULTI = 150
+LEARN_DIVERGED = 151
+
+PROPOSE_MIN_US = 20_000
+PROPOSE_SPAN_US = 180_000
+RETRY_MIN_US = 150_000
+RETRY_SPAN_US = 250_000
+NEXT_US = 15_000
+
+IDLE, PREPARING, ACCEPTING = 0, 1, 2
+
+
+@struct.dataclass
+class MultiPaxosState:
+    # acceptor (durable per-slot stable storage)
+    promised: jax.Array    # int32[N, S] highest ballot promised (-1 none)
+    acc_ballot: jax.Array  # int32[N, S] ballot of accepted value (-1 none)
+    acc_value: jax.Array   # int32[N, S] accepted value (0 none)
+    # learned log (durable; what a state machine would execute)
+    learned: jax.Array     # int32[N, S] (0 = unknown)
+    round: jax.Array       # int32[N] rising ballot round (durable)
+    # proposer (volatile)
+    phase: jax.Array       # int32[N]
+    cur_slot: jax.Array    # int32[N] slot being driven
+    ballot: jax.Array      # int32[N]
+    promises: jax.Array    # int32[N]
+    best_ballot: jax.Array # int32[N]
+    best_value: jax.Array  # int32[N]
+    accepts: jax.Array     # int32[N]
+    # ghost chosen registers (row 0, spec-only)
+    chosen_any: jax.Array  # bool[N, S]
+    chosen_val: jax.Array  # int32[N, S]
+    bad: jax.Array         # bool[N]
+
+
+class MultiPaxosMachine(Machine):
+    PAYLOAD_WIDTH = 6
+    MAX_TIMERS = 2
+    NUM_PROPOSERS = 2
+
+    def __init__(self, num_nodes: int = 5, log_slots: int = 8):
+        self.NUM_NODES = num_nodes
+        self.MAX_MSGS = num_nodes - 1
+        self.majority = num_nodes // 2 + 1
+        self.S = log_slots
+
+    def init(self, rng_key) -> MultiPaxosState:
+        n, s = self.NUM_NODES, self.S
+        zns = jnp.zeros((n, s), jnp.int32)
+        z = jnp.zeros((n,), jnp.int32)
+        return MultiPaxosState(
+            promised=zns - 1,
+            acc_ballot=zns - 1,
+            acc_value=zns,
+            learned=zns,
+            round=z,
+            phase=z,
+            cur_slot=z,
+            ballot=z - 1,
+            promises=z,
+            best_ballot=z - 1,
+            best_value=z,
+            accepts=z,
+            chosen_any=jnp.zeros((n, s), bool),
+            chosen_val=zns,
+            bad=jnp.zeros((n,), bool),
+        )
+
+    def restart_if(self, nodes: MultiPaxosState, i, cond, rng_key) -> MultiPaxosState:
+        """Acceptor slots, learned log and the round counter are stable
+        storage; the proposer side restarts idle and re-derives its
+        working slot from the learned log."""
+        n = self.NUM_NODES
+        row = (jnp.arange(n) == i) & cond
+        set_row = lambda arr, v: jnp.where(row, v, arr)  # noqa: E731
+        return nodes.replace(
+            phase=set_row(nodes.phase, IDLE),
+            cur_slot=set_row(nodes.cur_slot, self._first_unlearned(nodes, i)),
+            ballot=set_row(nodes.ballot, -1),
+            promises=set_row(nodes.promises, 0),
+            best_ballot=set_row(nodes.best_ballot, -1),
+            best_value=set_row(nodes.best_value, 0),
+            accepts=set_row(nodes.accepts, 0),
+        )
+
+    # -- helpers --------------------------------------------------------------
+
+    def _is_proposer(self, node):
+        return node < self.NUM_PROPOSERS
+
+    def _my_value(self, node, slot):
+        return (slot + 1) * 16 + node + 1  # distinct non-zero per (slot, proposer)
+
+    def _first_unlearned(self, nodes: MultiPaxosState, node):
+        unk = nodes.learned[node] == 0
+        return jnp.where(jnp.any(unk), jnp.argmax(unk), self.S).astype(jnp.int32)
+
+    def _accept_guard(self, nodes: MultiPaxosState, node, slot, b) -> jax.Array:
+        """The ballot check the bug variant drops."""
+        return b >= nodes.promised[node, slot]
+
+    def _learn(self, nodes: MultiPaxosState, node, slot, value, cond) -> MultiPaxosState:
+        """Record a learned value and advance the working slot past the
+        learned prefix."""
+        unknown = cond & (nodes.learned[node, slot] == 0)
+        nodes = nodes.replace(
+            learned=jnp.where(unknown, set2d(nodes.learned, node, slot, value), nodes.learned)
+        )
+        nxt = self._first_unlearned(nodes, node)
+        bump = cond & (slot == nodes.cur_slot[node])
+        return update_node(
+            nodes, node,
+            cur_slot=jnp.where(bump, nxt, nodes.cur_slot[node]),
+            phase=jnp.where(bump, IDLE, nodes.phase[node]),
+        )
+
+    def _start_prepare(self, nodes: MultiPaxosState, node, outbox: Outbox, cond) -> Tuple[MultiPaxosState, Outbox]:
+        """Begin a new ballot for the current slot (self-promise +
+        broadcast PREPARE). The round jumps past whatever our own
+        acceptor promised for the slot so the ballot is always
+        self-promisable."""
+        n = self.NUM_NODES
+        slot = jnp.minimum(nodes.cur_slot[node], self.S - 1)
+        round_eff = jnp.maximum(
+            nodes.round[node], (nodes.promised[node, slot] - node) // n + 1
+        )
+        new_ballot = round_eff * n + node
+        nodes = update_node(
+            nodes, node,
+            phase=jnp.where(cond, PREPARING, nodes.phase[node]),
+            ballot=jnp.where(cond, new_ballot, nodes.ballot[node]),
+            round=jnp.where(cond, round_eff + 1, nodes.round[node]),
+            promises=jnp.where(cond, 1, nodes.promises[node]),
+            best_ballot=jnp.where(cond, nodes.acc_ballot[node, slot], nodes.best_ballot[node]),
+            best_value=jnp.where(cond, nodes.acc_value[node, slot], nodes.best_value[node]),
+            accepts=jnp.where(cond, 0, nodes.accepts[node]),
+        )
+        nodes = nodes.replace(promised=jnp.where(
+            cond, set2d(nodes.promised, node, slot, new_ballot), nodes.promised
+        ))
+        prepare = make_payload(self.PAYLOAD_WIDTH, M_PREPARE, slot, new_ballot)
+        peers = (node + jnp.arange(1, n, dtype=jnp.int32)) % n
+        for i in range(self.MAX_MSGS):
+            outbox = send_if(outbox, i, cond, peers[i], prepare)
+        return nodes, outbox
+
+    # -- timers ---------------------------------------------------------------
+
+    def on_timer(self, nodes: MultiPaxosState, node, timer_id, now_us, rand_u32) -> Tuple[MultiPaxosState, Outbox]:
+        outbox = self.empty_outbox()
+        is_boot = timer_id == T_BOOT
+        is_prop = self._is_proposer(node)
+
+        delay = jnp.int32(PROPOSE_MIN_US) + (
+            rand_u32[0] % jnp.uint32(PROPOSE_SPAN_US)
+        ).astype(jnp.int32)
+        outbox = set_timer_if(outbox, 0, is_boot & is_prop, delay, T_PROPOSE)
+
+        fire = (timer_id == T_PROPOSE) | (timer_id == T_RETRY) | (timer_id == T_NEXT)
+        behind = nodes.cur_slot[node] < self.S
+        start = fire & is_prop & behind
+        nodes, outbox = self._start_prepare(nodes, node, outbox, start)
+        retry_delay = jnp.int32(RETRY_MIN_US) + (
+            rand_u32[1] % jnp.uint32(RETRY_SPAN_US)
+        ).astype(jnp.int32)
+        outbox = set_timer_if(
+            outbox, 1, (timer_id != T_NEXT) & fire & is_prop & behind, retry_delay, T_RETRY
+        )
+        return nodes, outbox
+
+    # -- messages -------------------------------------------------------------
+
+    def on_message(self, nodes: MultiPaxosState, node, src, payload, now_us, rand_u32) -> Tuple[MultiPaxosState, Outbox]:
+        outbox = self.empty_outbox()
+        mtype, slot = payload[0], jnp.clip(payload[1], 0, self.S - 1)
+        n = self.NUM_NODES
+        peers = (node + jnp.arange(1, n, dtype=jnp.int32)) % n
+
+        # ---- acceptor: PREPARE -> PROMISE or NACK ----
+        is_prep = mtype == M_PREPARE
+        b = payload[2]
+        grant = is_prep & (b > nodes.promised[node, slot])
+        nodes = nodes.replace(promised=jnp.where(
+            grant, set2d(nodes.promised, node, slot, b), nodes.promised
+        ))
+        promise = make_payload(
+            self.PAYLOAD_WIDTH, M_PROMISE, slot, b,
+            nodes.acc_ballot[node, slot], nodes.acc_value[node, slot],
+        )
+        outbox = send_if(outbox, 0, grant, src, promise)
+        nack = make_payload(self.PAYLOAD_WIDTH, M_NACK, slot, b)
+        outbox = send_if(outbox, 0, is_prep & ~grant, src, nack)
+
+        # ---- proposer: PROMISE ----
+        is_promise = (mtype == M_PROMISE) & self._is_proposer(node)
+        p_b, p_accb, p_accv = payload[2], payload[3], payload[4]
+        counts = (
+            is_promise
+            & (nodes.phase[node] == PREPARING)
+            & (p_b == nodes.ballot[node])
+            & (slot == jnp.minimum(nodes.cur_slot[node], self.S - 1))
+        )
+        better = counts & (p_accb > nodes.best_ballot[node])
+        new_promises = nodes.promises[node] + jnp.where(counts, 1, 0)
+        nodes = update_node(
+            nodes, node,
+            promises=new_promises,
+            best_ballot=jnp.where(better, p_accb, nodes.best_ballot[node]),
+            best_value=jnp.where(better, p_accv, nodes.best_value[node]),
+        )
+        quorum = counts & (new_promises >= self.majority)
+        value = jnp.where(
+            nodes.best_ballot[node] >= 0, nodes.best_value[node],
+            self._my_value(node, slot),
+        )
+        self_ok = quorum & self._accept_guard(nodes, node, slot, nodes.ballot[node])
+        nodes = update_node(
+            nodes, node,
+            phase=jnp.where(quorum, ACCEPTING, nodes.phase[node]),
+            accepts=jnp.where(quorum, jnp.where(self_ok, 1, 0), nodes.accepts[node]),
+        )
+        nodes = nodes.replace(
+            acc_ballot=jnp.where(
+                self_ok, set2d(nodes.acc_ballot, node, slot, nodes.ballot[node]), nodes.acc_ballot
+            ),
+            acc_value=jnp.where(
+                self_ok, set2d(nodes.acc_value, node, slot, value), nodes.acc_value
+            ),
+        )
+        accept = make_payload(self.PAYLOAD_WIDTH, M_ACCEPT, slot, nodes.ballot[node], value)
+        for i in range(self.MAX_MSGS):
+            outbox = send_if(outbox, i, quorum, peers[i], accept)
+
+        # ---- acceptor: ACCEPT -> ACCEPTED or NACK ----
+        is_acc = mtype == M_ACCEPT
+        a_b, a_v = payload[2], payload[3]
+        take = is_acc & self._accept_guard(nodes, node, slot, a_b)
+        nodes = nodes.replace(
+            promised=jnp.where(
+                take,
+                set2d(nodes.promised, node, slot, jnp.maximum(a_b, nodes.promised[node, slot])),
+                nodes.promised,
+            ),
+            acc_ballot=jnp.where(take, set2d(nodes.acc_ballot, node, slot, a_b), nodes.acc_ballot),
+            acc_value=jnp.where(take, set2d(nodes.acc_value, node, slot, a_v), nodes.acc_value),
+        )
+        accepted = make_payload(self.PAYLOAD_WIDTH, M_ACCEPTED, slot, a_b, a_v)
+        outbox = send_if(outbox, 0, take, src, accepted)
+
+        # ---- proposer: ACCEPTED -> chosen on majority ----
+        is_acked = (mtype == M_ACCEPTED) & self._is_proposer(node)
+        k_b, k_v = payload[2], payload[3]
+        counts2 = (
+            is_acked
+            & (nodes.phase[node] == ACCEPTING)
+            & (k_b == nodes.ballot[node])
+            & (slot == jnp.minimum(nodes.cur_slot[node], self.S - 1))
+        )
+        new_accepts = nodes.accepts[node] + jnp.where(counts2, 1, 0)
+        chosen = counts2 & (new_accepts >= self.majority)
+        nodes = update_node(nodes, node, accepts=new_accepts)
+
+        # ghost per-slot chosen register (agreement check, row 0)
+        conflict = chosen & nodes.chosen_any[0, slot] & (nodes.chosen_val[0, slot] != k_v)
+        first = chosen & ~nodes.chosen_any[0, slot]
+        nodes = nodes.replace(
+            chosen_any=jnp.where(first, set2d(nodes.chosen_any, 0, slot, True), nodes.chosen_any),
+            chosen_val=jnp.where(first, set2d(nodes.chosen_val, 0, slot, k_v), nodes.chosen_val),
+            bad=jnp.where(conflict, nodes.bad | (jnp.arange(n) == 0), nodes.bad),
+        )
+        # learn locally, advance to the next slot soon, tell everyone
+        nodes = self._learn(nodes, node, slot, k_v, chosen)
+        learn = make_payload(self.PAYLOAD_WIDTH, M_LEARN, slot, k_v)
+        for i in range(self.MAX_MSGS):
+            outbox = send_if(outbox, i, chosen, peers[i], learn)
+        outbox = set_timer_if(
+            outbox, 0, chosen & (nodes.cur_slot[node] < self.S), NEXT_US, T_NEXT
+        )
+
+        # ---- anyone: LEARN ----
+        is_learn = mtype == M_LEARN
+        nodes = self._learn(nodes, node, slot, payload[2], is_learn)
+
+        return nodes, outbox
+
+    # -- invariants / results --------------------------------------------------
+
+    def invariant(self, nodes: MultiPaxosState, now_us):
+        agree_viol = nodes.bad[0]
+        diverged = jnp.any(
+            (nodes.learned != 0)
+            & nodes.chosen_any[0][None, :]
+            & (nodes.learned != nodes.chosen_val[0][None, :])
+        )
+        ok = ~(agree_viol | diverged)
+        code = jnp.where(agree_viol, AGREEMENT_MULTI, jnp.where(diverged, LEARN_DIVERGED, 0))
+        return ok, code.astype(jnp.int32)
+
+    def is_done(self, nodes: MultiPaxosState, now_us):
+        return jnp.all(nodes.learned[: self.NUM_PROPOSERS] != 0)
+
+    def summary(self, nodes: MultiPaxosState):
+        return {
+            "slots_chosen": jnp.sum(nodes.chosen_any[0].astype(jnp.int32)),
+            "max_round": jnp.max(nodes.round[: self.NUM_PROPOSERS]),
+        }
+
+
+class NoPromiseCheckMultiPaxos(MultiPaxosMachine):
+    """Bug variant: acceptors take any ACCEPT regardless of their
+    promise — dueling proposers + chaos get two values majority-accepted
+    in one slot (AGREEMENT_MULTI)."""
+
+    def _accept_guard(self, nodes: MultiPaxosState, node, slot, b) -> jax.Array:
+        return jnp.bool_(True)

@@ -82,6 +82,17 @@ class RaftMachine(Machine):
     # term-2 ones); kept as a flag so the bug class stays testable.
     COMMIT_TO_LOG_LEN = False
 
+    # Leader commit quorum. False (correct): an entry commits when
+    # replicated on a strict majority. True reproduces a
+    # quorum-off-by-one bug (commit at majority-1 acks, i.e. leader +
+    # one follower on a 5-node cluster). Triggering a *safety* violation
+    # needs the leader plus its one follower sustained-isolated from a
+    # majority that elects and commits divergently — a 2/3 group split
+    # clogs 6 links at once, unreachable for the legacy two-pair-clog
+    # fault vocabulary; FaultPlan(allow_group=True) finds it (the
+    # round-3 new-fault-kinds demo, see tests/test_engine.py).
+    QUORUM_OFF_BY_ONE = False
+
     def __init__(self, num_nodes: int = 5, log_capacity: int = 8):
         self.NUM_NODES = num_nodes
         self.MAX_MSGS = num_nodes - 1
@@ -405,7 +416,8 @@ class RaftMachine(Machine):
             replicated = nodes.match_idx[node][None, :] >= idxs[:, None]  # [CAP+1, N]
             cnt = jnp.sum(replicated, axis=1)
             cur_term_entry = nodes.log_term[node] == nodes.term[node]  # [CAP+1]
-            committable = (cnt >= self.majority) & cur_term_entry & (idxs >= 1) & (idxs <= nodes.log_len[node])
+            quorum = self.majority - 1 if self.QUORUM_OFF_BY_ONE else self.majority
+            committable = (cnt >= quorum) & cur_term_entry & (idxs >= 1) & (idxs <= nodes.log_len[node])
             best = jnp.max(jnp.where(committable, idxs, 0))
             nodes = update_node(
                 nodes, node,

@@ -476,3 +476,206 @@ def test_run_stream_sharded_over_mesh(raft_engine):
     )
     assert sharded == unsharded  # sharding never changes results
     assert sharded["completed"] >= 32
+
+
+# -- widened chaos vocabulary (round 3): directional clogs, group
+# -- partitions, loss storms (host-fabric parity: Direction at
+# -- network.rs:108, group partition(), loss config)
+
+
+def test_fault_kind_coverage_all_kinds_scheduled():
+    """With every kind enabled, a modest seed batch schedules all five
+    apply ops (and their undos) — no kind is unreachable."""
+    from madsim_tpu.engine.core import (
+        EV_FAULT,
+        F_CLOG_DIR,
+        F_CLOG_GROUP,
+        F_CLOG_PAIR,
+        F_KILL,
+        F_LOSS_STORM,
+    )
+
+    cfg = EngineConfig(
+        horizon_us=5_000_000,
+        queue_capacity=96,
+        faults=FaultPlan(
+            n_faults=3,
+            allow_partition=True,
+            allow_kill=True,
+            allow_dir_clog=True,
+            allow_group=True,
+            allow_storm=True,
+            t_max_us=3_000_000,
+        ),
+    )
+    eng = Engine(RaftMachine(5, 8), cfg)
+    state = eng.init_batch(jnp.arange(128, dtype=jnp.uint32))
+    is_fault = (state.eq_kind == EV_FAULT) & state.eq_valid
+    ops = state.eq_payload[..., 0][is_fault].tolist()
+    applies = {op for op in ops if op % 2 == 0}
+    assert applies == {F_CLOG_PAIR, F_KILL, F_CLOG_DIR, F_CLOG_GROUP, F_LOSS_STORM}
+    undos = {op for op in ops if op % 2 == 1}
+    assert undos == {op + 1 for op in applies}
+
+
+def test_directional_clog_blocks_one_way_only():
+    """clogged[a, b] drops a->b sends while b->a still delivers (the
+    matrix was always directional; the new fault kind exposes it)."""
+    from madsim_tpu.models.echo import CLIENT, SERVER
+
+    eng = Engine(EchoMachine(rounds=3, retry_us=50_000), EngineConfig(queue_capacity=32))
+
+    def run_with_clog(src, dst):
+        state = eng.init_batch(jnp.zeros((1,), jnp.uint32))
+        clogged = state.clogged.at[0, src, dst].set(True)
+        state = state.replace(clogged=clogged)
+        return eng.run_segment(state, 40)
+
+    # client->server clogged: pings never arrive, nothing served or acked
+    out = run_with_clog(CLIENT, SERVER)
+    assert int(out.nodes.served[0, SERVER]) == 0
+    assert int(out.nodes.acked[0, CLIENT]) == 0
+    # server->client clogged: pings served, replies never arrive
+    rev = run_with_clog(SERVER, CLIENT)
+    assert int(rev.nodes.served[0, SERVER]) > 0
+    assert int(rev.nodes.acked[0, CLIENT]) == 0
+
+
+def test_loss_storm_drops_then_recovers():
+    """A full-rate storm stops delivery; clearing it lets retries finish
+    the workload."""
+    eng = Engine(
+        EchoMachine(rounds=3, retry_us=50_000),
+        EngineConfig(horizon_us=60_000_000, queue_capacity=32),
+    )
+    state = eng.init_batch(jnp.zeros((1,), jnp.uint32))
+    state = state.replace(storm_loss=jnp.full((1,), 65535, jnp.int32))
+    mid = eng.run_segment(state, 60)
+    assert int(mid.nodes.served[0, 1]) == 0  # storm drops every ping
+    assert not bool(mid.done[0])
+    cleared = mid.replace(storm_loss=jnp.zeros((1,), jnp.int32))
+    out = eng.run_segment(cleared, 200)
+    assert bool(out.done[0]) and not bool(out.failed[0])
+    assert int(out.nodes.acked[0, 0]) == 3
+
+
+def test_group_partition_clogs_exactly_cross_links():
+    """Replay a group-partition schedule and check the clogged matrix is
+    exactly the boundary-crossing links while the fault is active."""
+    from madsim_tpu.engine.core import EV_FAULT, F_CLOG_GROUP, F_UNCLOG_GROUP
+
+    import numpy as np
+
+    cfg = EngineConfig(
+        horizon_us=5_000_000,
+        queue_capacity=96,
+        faults=FaultPlan(
+            n_faults=1,
+            allow_partition=False,
+            allow_kill=False,
+            allow_group=True,
+            t_max_us=2_000_000,
+            dur_min_us=500_000,
+            dur_max_us=1_000_000,
+        ),
+    )
+    class NeverDoneRaft(RaftMachine):
+        # keep lanes alive past the fault schedule so apply AND heal fire
+        def is_done(self, nodes, now_us):
+            return jnp.bool_(False)
+
+    eng = Engine(NeverDoneRaft(5, 8), cfg)
+
+    seen = {"apply": 0, "heal": 0}
+
+    def on_step(ev, state):
+        if ev.kind != "fault":
+            return
+        op, mask = ev.payload[0], ev.payload[1]
+        in_g = np.array([(mask >> i) & 1 for i in range(5)], bool)
+        cross = in_g[:, None] != in_g[None, :]
+        got = np.asarray(state.clogged)
+        if op == F_CLOG_GROUP:
+            assert 0 < mask < 2**5 - 1  # non-trivial split
+            assert (got == cross).all()
+            seen["apply"] += 1
+        elif op == F_UNCLOG_GROUP:
+            assert not got.any()
+            seen["heal"] += 1
+
+    for seed in range(4):
+        replay(eng, seed, max_steps=1500, on_step=on_step)
+    assert seen["apply"] == 4 and seen["heal"] == 4
+
+
+def test_raft_safe_under_full_chaos_vocabulary():
+    """Raft invariants hold across the widened fault space (64 seeds of
+    mixed pair/kill/dir/group/storm chaos)."""
+    cfg = EngineConfig(
+        horizon_us=5_000_000,
+        queue_capacity=96,
+        faults=FaultPlan(
+            n_faults=3,
+            allow_dir_clog=True,
+            allow_group=True,
+            allow_storm=True,
+            t_max_us=3_000_000,
+            dur_min_us=200_000,
+            dur_max_us=800_000,
+        ),
+    )
+    eng = Engine(RaftMachine(5, 8), cfg)
+    res = eng.make_runner(max_steps=3000)(jnp.arange(64, dtype=jnp.uint32))
+    assert bool(res.done.all())
+    assert not bool(res.failed.any()), f"fail codes: {set(res.fail_code.tolist())}"
+
+
+def test_quorum_off_by_one_needs_group_partitions():
+    """A commit-below-majority bug is structurally out of reach for the
+    legacy vocabulary at this budget (isolating leader+follower from an
+    electing majority clogs 6 links at once; two pair-clogs cover 2) but
+    a single 2/3 group split finds it. The found seed replays
+    bit-identically on the host path."""
+    from madsim_tpu.models.raft import LOG_MATCHING
+
+    class QuorumBug(RaftMachine):
+        QUORUM_OFF_BY_ONE = True
+
+    seeds = jnp.arange(256, dtype=jnp.uint32)
+    legacy = FaultPlan(
+        n_faults=2, t_max_us=3_000_000, dur_min_us=400_000, dur_max_us=1_200_000
+    )
+    eng_legacy = Engine(
+        QuorumBug(5, 8), EngineConfig(horizon_us=5_000_000, queue_capacity=96, faults=legacy)
+    )
+    res_legacy = eng_legacy.make_runner(max_steps=3000)(seeds)
+    assert not bool(res_legacy.failed.any()), (
+        f"legacy vocabulary unexpectedly found it: {set(res_legacy.fail_code.tolist())}"
+    )
+
+    group = FaultPlan(
+        n_faults=2,
+        allow_partition=False,
+        allow_kill=False,
+        allow_group=True,
+        t_max_us=3_000_000,
+        dur_min_us=400_000,
+        dur_max_us=1_200_000,
+    )
+    eng_group = Engine(
+        QuorumBug(5, 8), EngineConfig(horizon_us=5_000_000, queue_capacity=96, faults=group)
+    )
+    res_group = eng_group.make_runner(max_steps=3000)(seeds)
+    failing = res_group.seeds[res_group.failed].tolist()
+    assert failing, "group partitions failed to surface the quorum bug"
+    codes = {int(c) for c in res_group.fail_code.tolist() if c}
+    assert LOG_MATCHING in codes, f"codes: {codes}"
+    # the correct quorum rule survives the same group chaos
+    eng_fixed = Engine(
+        RaftMachine(5, 8), EngineConfig(horizon_us=5_000_000, queue_capacity=96, faults=group)
+    )
+    res_fixed = eng_fixed.make_runner(max_steps=3000)(seeds)
+    assert not bool(res_fixed.failed.any()), f"codes: {set(res_fixed.fail_code.tolist())}"
+    # bit-identical replay
+    rp = replay(eng_group, int(failing[0]), max_steps=3000)
+    assert rp.failed
