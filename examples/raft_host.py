@@ -136,6 +136,7 @@ class RaftNode:
 
     async def heartbeat(self, ep):
         acks = 1
+        term = self.term
         for peer_id, addr in enumerate(self.peers):
             if peer_id == self.me:
                 continue
@@ -158,6 +159,10 @@ class RaftNode:
                 self.next_idx[peer_id] = len(self.log)
             else:
                 self.next_idx[peer_id] = max(1, self.next_idx.get(peer_id, 1) - 1)
+        # an on_append_entries during the awaited ack loop can depose us;
+        # a deposed/newer-term node must not record these acks as a commit
+        if self.role != LEADER or self.term != term:
+            return
         if acks > len(self.peers) // 2:
             self.commit = len(self.log) - 1
             self.state["max_commit"] = max(self.state.get("max_commit", 0), self.commit)

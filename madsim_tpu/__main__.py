@@ -346,7 +346,8 @@ def cmd_shrink(args) -> int:
         f"--seed {args.seed} --nodes {args.nodes} "
         f"--horizon {sr.shrunk.horizon_us / 1e6} --queue {sr.shrunk.queue_capacity} "
         f"--faults {f.n_faults} --fault-tmax {f.t_max_us} "
-        f"--loss {sr.shrunk.packet_loss_rate} --max-steps {sr.steps}"
+        f"--loss {sr.shrunk.packet_loss_rate} --max-steps {sr.steps} "
+        f"--fault-kinds {getattr(args, 'fault_kinds', 'pair,kill')}"
     )
     return 0
 

@@ -292,9 +292,14 @@ class Engine:
                 b = (a + b_off) % n
                 kinds = jnp.asarray(fp.enabled_kinds(), jnp.int32)
                 kind = kinds[jax.random.bits(k5, (), jnp.uint32) % jnp.uint32(len(kinds))]
-                # non-trivial bitmask: at least one node on each side
+                # non-trivial bitmask: at least one node on each side.
+                # Clamp the modulus to 30 bits: the draw happens
+                # unconditionally (constant draw count), so without the
+                # clamp a dir/storm-only plan on n > 32 nodes would
+                # overflow uint32 at lane init even though allow_group
+                # is gated to 2 <= n <= 30.
                 mask = 1 + (
-                    jax.random.bits(k6, (), jnp.uint32) % jnp.uint32(2**n - 2)
+                    jax.random.bits(k6, (), jnp.uint32) % jnp.uint32(2 ** min(n, 30) - 2)
                 ).astype(jnp.int32)
                 op_apply = (2 * kind).astype(jnp.int32)
                 op_undo = (2 * kind + 1).astype(jnp.int32)

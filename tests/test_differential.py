@@ -96,6 +96,48 @@ def test_same_bug_class_caught_by_both_engines(raft_engine):
     assert report["host_violations"] >= 1, report
 
 
+def test_loss_storm_observably_suppresses_host_traffic():
+    """Regression guard for the round-3 silent no-op: the storm replay
+    must mutate the rate the fabric actually reads
+    (net.config.net.packet_loss_rate, not a fresh attribute on the outer
+    Config). Observed behaviorally: a near-total storm covering the whole
+    horizon must prevent any leader election, and the same seeds elect
+    once the storm lifts mid-horizon."""
+    from madsim_tpu.engine.core import F_LOSS_END, F_LOSS_STORM
+
+    horizon = 3_000_000
+    full_storm = [{"t_us": 0, "op": F_LOSS_STORM, "a": 65535, "b": 0}]
+    for seed in range(4):
+        out = run_host_raft(seed, full_storm, horizon_us=horizon)
+        assert not out["elected"], (seed, out)
+        assert out["loss_trace"] == [(0, 0.0), (0, 65535 / 65536.0)]
+
+    lifted = full_storm + [{"t_us": 1_000_000, "op": F_LOSS_END, "a": 0, "b": 0}]
+    elected = 0
+    for seed in range(4):
+        out = run_host_raft(seed, lifted, horizon_us=horizon)
+        elected += bool(out["elected"])
+        assert out["loss_trace"][-1] == (1_000_000, 0.0)
+    assert elected >= 3
+
+
+def test_loss_storm_composites_with_base_rate():
+    """ADVICE r3: storms add to the engine's static packet_loss_rate and
+    F_LOSS_END restores the base (not 0.0)."""
+    from madsim_tpu.engine.core import F_LOSS_END, F_LOSS_STORM
+
+    sched = [
+        {"t_us": 100_000, "op": F_LOSS_STORM, "a": 32768, "b": 0},
+        {"t_us": 200_000, "op": F_LOSS_END, "a": 0, "b": 0},
+    ]
+    out = run_host_raft(0, sched, horizon_us=400_000, base_loss=0.25)
+    assert out["loss_trace"] == [
+        (0, 0.25),
+        (100_000, 0.25 + 32768 / 65536.0),
+        (200_000, 0.25),
+    ]
+
+
 def test_host_schedule_replay_covers_v2_kinds():
     """Directional clogs, group partitions and loss storms translate to
     host chaos ops and apply at the scheduled times."""

@@ -256,3 +256,138 @@ def test_metadata_and_interceptors():
         return await client.spawn(go())
 
     assert run(main)
+
+
+# -- .proto ingestion (reference: madsim-tonic-build) -------------------------
+
+_REF_PROTO = "/root/reference/tonic-example/proto/helloworld.proto"
+
+
+def _hello_ns():
+    """Ingest the reference's own helloworld.proto when present (the
+    VERDICT done-bar), falling back to the in-repo twin."""
+    import os
+
+    from madsim_tpu.grpc import build
+
+    path = _REF_PROTO if os.path.exists(_REF_PROTO) else os.path.join(
+        os.path.dirname(__file__), "protos", "helloworld.proto"
+    )
+    return build.load(path)
+
+
+def test_proto_ingestion_four_shapes_no_handwritten_stubs():
+    """The reference's helloworld.proto drives server+client end to end:
+    messages are real protobuf classes, stubs are synthesized from the
+    descriptor (no @grpc.service hand-writing anywhere)."""
+    hw = _hello_ns()
+
+    class MyGreeter(hw.GreeterServer):
+        async def say_hello(self, request):
+            return hw.HelloReply(message=f"Hello {request.into_inner().name}!")
+
+        async def lots_of_replies(self, request):
+            name = request.into_inner().name
+            for i in range(3):
+                await sim_time.sleep(0.05)
+                yield hw.HelloReply(message=f"{name} #{i}")
+
+        async def lots_of_greetings(self, stream):
+            names = [m.name async for m in stream]
+            return hw.HelloReply(message=f"Hello {', '.join(names)}!")
+
+        async def bidi_hello(self, stream):
+            async for m in stream:
+                yield hw.HelloReply(message=f"Hello {m.name}!")
+
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await grpc.Server.builder().add_service(MyGreeter()).serve("0.0.0.0:50051")
+
+        handle.create_node().name("server").ip("10.5.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        client = handle.create_node().name("client").ip("10.5.0.2").build()
+
+        async def go():
+            cl = await hw.GreeterClient.connect("http://10.5.0.1:50051")
+            r1 = await cl.say_hello(hw.HelloRequest(name="world"))
+            stream = await cl.lots_of_replies(hw.HelloRequest(name="srv"))
+            r2 = [m.message async for m in stream]
+            r3 = await cl.lots_of_greetings([hw.HelloRequest(name=n) for n in "abc"])
+            stream = await cl.bidi_hello([hw.HelloRequest(name=n) for n in ("x", "y")])
+            r4 = [m.message async for m in stream]
+            return r1.message, r2, r3.message, r4
+
+        return await client.spawn(go())
+
+    r1, r2, r3, r4 = run(main)
+    assert r1 == "Hello world!"
+    assert r2 == ["srv #0", "srv #1", "srv #2"]
+    assert r3 == "Hello a, b, c!"
+    assert r4 == ["Hello x!", "Hello y!"]
+
+
+def test_proto_ingestion_wrapper_impl_and_unimplemented():
+    """tonic-build's `GreeterServer::new(MyGreeter)` style: wrap a plain
+    impl object; rpcs the impl doesn't define come back UNIMPLEMENTED;
+    two services from one proto coexist on one server."""
+    hw = _hello_ns()
+
+    class PlainImpl:
+        async def say_hello(self, request):
+            return hw.HelloReply(message=f"hi {request.into_inner().name}")
+
+    async def main():
+        handle = Handle.current()
+
+        async def serve():
+            await (
+                grpc.Server.builder()
+                .add_service(hw.GreeterServer(PlainImpl()))
+                .add_service(hw.AnotherGreeterServer(PlainImpl()))
+                .serve("0.0.0.0:50051")
+            )
+
+        handle.create_node().name("server").ip("10.5.0.1").init(serve).build()
+        await sim_time.sleep(0.2)
+        client = handle.create_node().ip("10.5.0.2").build()
+
+        async def go():
+            cl = await hw.GreeterClient.connect("http://10.5.0.1:50051")
+            r1 = await cl.say_hello(hw.HelloRequest(name="a"))
+            cl2 = await hw.AnotherGreeterClient.connect("http://10.5.0.1:50051")
+            r2 = await cl2.say_hello(hw.HelloRequest(name="b"))
+            with pytest.raises(grpc.Status) as ei:
+                stream = await cl.lots_of_replies(hw.HelloRequest(name="x"))
+                [m async for m in stream]
+            assert ei.value.code == grpc.Code.UNIMPLEMENTED
+            return r1.message, r2.message
+
+        return await client.spawn(go())
+
+    r1, r2 = run(main)
+    assert (r1, r2) == ("hi a", "hi b")
+
+
+def test_proto_emit_module(tmp_path):
+    """`python -m madsim_tpu.grpc.build x.proto -o x_pb.py` emits an
+    importable generated module (the build-script route)."""
+    import importlib.util
+    import os
+
+    from madsim_tpu.grpc import build
+
+    src = _REF_PROTO if os.path.exists(_REF_PROTO) else os.path.join(
+        os.path.dirname(__file__), "protos", "helloworld.proto"
+    )
+    out = tmp_path / "helloworld_pb.py"
+    build.emit(src, str(out))
+    spec = importlib.util.spec_from_file_location("helloworld_pb", out)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.HelloRequest(name="x").name == "x"
+    assert "helloworld.Greeter" in mod.services
+    assert mod.GreeterServer.__grpc_methods__["SayHello"] == ("say_hello", "unary")
+    assert mod.GreeterServer.__grpc_methods__["BidiHello"] == ("bidi_hello", "streaming")

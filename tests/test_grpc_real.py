@@ -1,0 +1,171 @@
+"""Generated gRPC stubs in REAL mode: the same classes that run on the
+sim fabric speak genuine protobuf-over-HTTP/2 via grpc.aio (reference:
+madsim-tonic's non-sim build re-exporting real tonic, lib.rs:1-8).
+Runs fully in-process against grpc.aio — no external services."""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytest.importorskip("grpc")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REF_PROTO = "/root/reference/tonic-example/proto/helloworld.proto"
+
+
+def _proto_path():
+    return _REF_PROTO if os.path.exists(_REF_PROTO) else os.path.join(
+        os.path.dirname(__file__), "protos", "helloworld.proto"
+    )
+
+
+def _ns():
+    from madsim_tpu.grpc import build
+
+    return build.load(_proto_path())
+
+
+class _Impl:
+    def __init__(self, hw):
+        self.hw = hw
+
+    async def say_hello(self, request):
+        from madsim_tpu import grpc as sgrpc
+
+        name = request.into_inner().name
+        if name == "error":
+            raise sgrpc.Status(sgrpc.Code.INVALID_ARGUMENT, "bad name")
+        return self.hw.HelloReply(message=f"Hello {name}!")
+
+    async def lots_of_replies(self, request):
+        name = request.into_inner().name
+        for i in range(3):
+            yield self.hw.HelloReply(message=f"{name} #{i}")
+
+    async def lots_of_greetings(self, stream):
+        names = []
+        while (m := await stream.message()) is not None:
+            names.append(m.name)
+        return self.hw.HelloReply(message=f"Hello {', '.join(names)}!")
+
+    async def bidi_hello(self, stream):
+        while (m := await stream.message()) is not None:
+            yield self.hw.HelloReply(message=f"Hello {m.name}!")
+
+
+def test_real_mode_four_shapes_and_status():
+    hw = _ns()
+
+    async def main():
+        from madsim_tpu import grpc as sgrpc
+        from madsim_tpu.grpc.real import RealChannel, RealRouter
+
+        router = RealRouter().add_service(hw.GreeterServer(_Impl(hw)))
+        port = await router.start("127.0.0.1:0")
+        ch = await RealChannel.connect(
+            f"127.0.0.1:{port}", hw.GreeterClient._METHODS, timeout=5.0
+        )
+        try:
+            r1 = await ch.unary(
+                "/helloworld.Greeter/SayHello", hw.HelloRequest(name="real")
+            )
+            stream = await ch.server_streaming(
+                "/helloworld.Greeter/LotsOfReplies", hw.HelloRequest(name="s")
+            )
+            r2 = [m.message async for m in stream]
+            r3 = await ch.client_streaming(
+                "/helloworld.Greeter/LotsOfGreetings",
+                [hw.HelloRequest(name=n) for n in "ab"],
+            )
+            stream = await ch.streaming(
+                "/helloworld.Greeter/BidiHello",
+                [hw.HelloRequest(name=n) for n in ("x", "y")],
+            )
+            r4 = [m.message async for m in stream]
+            with pytest.raises(sgrpc.Status) as ei:
+                await ch.unary(
+                    "/helloworld.Greeter/SayHello", hw.HelloRequest(name="error")
+                )
+            assert ei.value.code == sgrpc.Code.INVALID_ARGUMENT
+            return r1.message, r2, r3.message, r4
+        finally:
+            await ch.close()
+            await router.stop()
+
+    r1, r2, r3, r4 = asyncio.run(main())
+    assert r1 == "Hello real!"
+    assert r2 == ["s #0", "s #1", "s #2"]
+    assert r3 == "Hello a, b!"
+    assert r4 == ["Hello x!", "Hello y!"]
+
+
+def test_real_mode_metadata_rides_both_ways():
+    hw = _ns()
+
+    async def main():
+        from madsim_tpu import grpc as sgrpc
+        from madsim_tpu.grpc.real import RealChannel, RealRouter
+
+        seen = {}
+
+        class MdImpl(_Impl):
+            async def say_hello(self, request):
+                seen.update(request.metadata)
+                return self.hw.HelloReply(message="ok")
+
+        router = RealRouter().add_service(hw.GreeterServer(MdImpl(hw)))
+        port = await router.start("127.0.0.1:0")
+        ch = await RealChannel.connect(
+            f"127.0.0.1:{port}", hw.GreeterClient._METHODS, timeout=5.0
+        )
+        try:
+            rsp = await ch.unary(
+                "/helloworld.Greeter/SayHello",
+                sgrpc.Request(hw.HelloRequest(name="m"), {"x-token": "t1"}),
+            )
+            return seen.get("x-token"), rsp.into_inner().message
+        finally:
+            await ch.close()
+            await router.stop()
+
+    token, msg = asyncio.run(main())
+    assert token == "t1"
+    assert msg == "ok"
+
+
+def test_generated_client_mode_switch_subprocess():
+    """MADSIM_TPU_MODE=real flips GeneratedClient.connect to the grpc.aio
+    path — the `#[cfg(madsim)]` dual-build switch, end to end."""
+    code = f"""
+import asyncio, sys
+sys.path.insert(0, {REPO!r})
+from madsim_tpu.grpc import build
+from madsim_tpu.grpc.real import RealRouter
+
+hw = build.load({_proto_path()!r})
+
+class Impl:
+    async def say_hello(self, request):
+        return hw.HelloReply(message="via " + request.into_inner().name)
+
+async def main():
+    router = RealRouter().add_service(hw.GreeterServer(Impl()))
+    port = await router.start("127.0.0.1:0")
+    cl = await hw.GreeterClient.connect(f"127.0.0.1:{{port}}", timeout=5.0)
+    rsp = await cl.say_hello(hw.HelloRequest(name="realmode"))
+    print("GOT:" + rsp.message)
+    await router.stop()
+
+asyncio.run(main())
+"""
+    env = dict(os.environ)
+    env["MADSIM_TPU_MODE"] = "real"
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True, timeout=120
+    )
+    assert out.returncode == 0, out.stderr
+    assert "GOT:via realmode" in out.stdout
