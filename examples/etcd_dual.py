@@ -12,6 +12,16 @@ under `cfg(not(madsim))` so app code is identical in test and prod):
   real:           MADSIM_TPU_MODE=real python -m madsim_tpu serve --service etcd --addr 127.0.0.1:23790 &
                   MADSIM_TPU_MODE=real python examples/etcd_dual.py 127.0.0.1:23790
       -> the same client code over real asyncio TCP to a real server
+
+  real + genuine etcd:
+                  MADSIM_TPU_MODE=real python examples/etcd_dual.py <etcd-host>:2379
+      -> Client.connect probes the endpoint with an etcd v3 Status rpc;
+         a genuine etcd (or `madsim_tpu serve --service etcd --grpc`)
+         answers, and every call goes over the real etcd wire protocol
+         (services/etcd/real_client.py — the analogue of the reference
+         re-exporting etcd_client in non-sim builds, lib.rs:5-6).
+         Unreachable/non-etcd endpoints fall back to the pickle
+         sim-protocol server above.
 """
 
 from __future__ import annotations

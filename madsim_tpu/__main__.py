@@ -387,6 +387,28 @@ def cmd_serve(args) -> int:
     import asyncio
 
     async def run_server() -> None:
+        if getattr(args, "grpc", False):
+            if args.service != "etcd":
+                sys.exit("--grpc is only available for --service etcd")
+            from .services.etcd.real_gateway import EtcdGrpcGateway
+
+            gw = EtcdGrpcGateway()
+            port = await gw.start(args.addr)
+            host = args.addr.rsplit(":", 1)[0]
+            print(f"etcd serving on {host}:{port} (genuine gRPC wire)", flush=True)
+            await gw.wait()
+            return
+        if getattr(args, "http", False):
+            if args.service != "s3":
+                sys.exit("--http is only available for --service s3")
+            from .services.s3.real_gateway import S3HttpGateway
+
+            gw = S3HttpGateway()
+            port = await gw.start(args.addr)
+            host = args.addr.rsplit(":", 1)[0]
+            print(f"s3 serving on {host}:{port} (genuine S3 REST wire)", flush=True)
+            await gw.wait()
+            return
         if args.service == "etcd":
             from .services.etcd import SimServer
 
@@ -511,6 +533,18 @@ def main(argv=None) -> int:
     )
     p.add_argument("--service", default="etcd", choices=["etcd", "kafka", "s3"])
     p.add_argument("--addr", default="127.0.0.1:23790", help="host:port (port 0 = ephemeral)")
+    p.add_argument(
+        "--grpc",
+        action="store_true",
+        help="etcd only: serve the genuine etcd v3 gRPC wire protocol "
+        "(etcdserverpb over grpc.aio) instead of the pickle sim protocol",
+    )
+    p.add_argument(
+        "--http",
+        action="store_true",
+        help="s3 only: serve the genuine S3 REST wire protocol "
+        "instead of the pickle sim protocol",
+    )
     p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)

@@ -37,4 +37,14 @@ else:
 
     IS_SIM = True
 
-__all__ = ["net", "task", "time", "rand", "MODE", "IS_SIM"]
+def real_passthrough_enabled() -> bool:
+    """Gate for the genuine-backend probes in real mode
+    (etcd gRPC / kafka ApiVersions / s3 HTTP). Default on; set
+    MADSIM_TPU_REAL_PASSTHROUGH=0 to always use the sim-protocol
+    servers and skip the probe latency."""
+    return os.environ.get("MADSIM_TPU_REAL_PASSTHROUGH", "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+__all__ = ["net", "task", "time", "rand", "MODE", "IS_SIM", "real_passthrough_enabled"]

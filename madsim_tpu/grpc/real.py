@@ -116,6 +116,12 @@ class RealChannel:
     async def close(self) -> None:
         await self._chan.close()
 
+    def set_default_timeout(self, timeout: Optional[float]) -> None:
+        """Per-call deadline for subsequent RPCs. Callers that probe
+        with a short deadline must reset it afterwards, or long-lived
+        streams (watch, blocking Campaign) inherit the probe deadline."""
+        self._timeout = timeout
+
     def _prepare(self, msg: Any) -> tuple:
         wrapped = isinstance(msg, Request)
         request = msg if wrapped else Request(msg)

@@ -130,3 +130,14 @@ class StreamCaller:
             except Exception:  # noqa: BLE001 - best-effort teardown
                 pass
             self._stream = None
+
+    def close(self) -> None:
+        """Release the cached stream and the local endpoint (real mode:
+        the TCP fd) — client `close()` must not leak per-backend."""
+        self._drop_stream()
+        if self._ep is not None:
+            try:
+                self._ep.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+            self._ep = None

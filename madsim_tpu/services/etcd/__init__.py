@@ -402,14 +402,35 @@ class Client:
     def __init__(self, addr):
         self._addr = addr
         self._caller = StreamCaller()
+        # real mode with a genuine etcd reachable: every op goes through
+        # the etcd wire protocol instead of the sim pickle protocol
+        # (reference: madsim-etcd-client/src/lib.rs:5-6 `pub use
+        # etcd_client::*` in the non-sim build)
+        self._real = None
 
     @staticmethod
     async def connect(endpoints: Union[str, Sequence[str]], timeout: Optional[float] = None) -> "Client":
         if isinstance(endpoints, str):
             endpoints = [endpoints]
+        from ...dual import IS_SIM, real_passthrough_enabled
+
+        if not IS_SIM and real_passthrough_enabled():
+            from .real_client import try_connect_real
+
+            backend = await try_connect_real(endpoints, probe_timeout=timeout or 2.0)
+            if backend is not None:
+                client = Client(endpoints[0])
+                client._real = backend
+                return client
         client = Client(parse_addr(endpoints[0]))
         await client._caller.open(client._addr)
         return client
+
+    async def close(self) -> None:
+        if self._real is not None:
+            await self._real.close()
+        if self._caller is not None:
+            self._caller.close()
 
     # reads are safe to transparently re-send after an ambiguous response
     # loss in real mode; mutations (put/txn/delete/lease_grant/campaign)
@@ -418,6 +439,8 @@ class Client:
                    "lease_time_to_live", "lease_list"}
 
     async def _call(self, req: tuple):
+        if self._real is not None:
+            return await self._real.call(req)
         rsp = await self._caller.call(req, idempotent=req[0] in self._IDEMPOTENT)
         if rsp is None:
             raise EtcdError("etcd server unavailable")
@@ -490,6 +513,8 @@ class Client:
         return await self._call(("resign", leader["leader"]))
 
     async def observe(self, name: Key) -> Observer:
+        if self._real is not None:
+            return await self._real.observe(_b(name))
         tx, rx = await self._open_sub()
         tx.send(("observe", _b(name)))
         head = await rx.recv()
@@ -528,6 +553,13 @@ class Client:
             hi = _b(range_end)
         else:
             hi = _prefix_end(k) if prefix else b""
+        if self._real is not None:
+            return await self._real.watch(k, hi, {
+                "start_revision": start_revision,
+                "filters": tuple(filters),
+                "prev_kv": prev_kv,
+                "progress_notify": progress_notify,
+            })
         tx, rx = await self._open_sub()
         tx.send(("watch", k, hi, {
             "start_revision": start_revision,

@@ -521,8 +521,21 @@ class _Conn:
 
     def __init__(self) -> None:
         self._caller = StreamCaller()
+        # real mode with a genuine broker at bootstrap.servers: the data
+        # plane rides the genuine client library (reference:
+        # madsim-rdkafka/src/lib.rs:5-12 vendoring real rdkafka)
+        self._real = None
 
     async def open(self, addr) -> None:
+        from ...dual import IS_SIM, real_passthrough_enabled
+
+        if not IS_SIM and real_passthrough_enabled():
+            from .real_client import RealKafkaConn, probe_real_kafka
+
+            host, port = addr
+            if await probe_real_kafka(host, port):
+                self._real = RealKafkaConn(f"{host}:{port}")
+                return
         await self._caller.open(addr)
 
     # commit_offsets is value-idempotent: it overwrites the same absolute
@@ -537,6 +550,8 @@ class _Conn:
                    "leave_group", "describe_group"}
 
     async def call(self, req: tuple):
+        if self._real is not None:
+            return await self._real.call(req)
         rsp = await self._caller.call(req, idempotent=req[0] in self._IDEMPOTENT)
         if rsp is None:
             raise KafkaError("broker unavailable", ErrorCode.TIMED_OUT)

@@ -1,0 +1,112 @@
+"""Real-client passthrough for Kafka (VERDICT directive 1): genuine
+brokers are detected with one frame of the real wire protocol
+(ApiVersions), the data plane rides kafka-python when installed, and
+non-Kafka endpoints (incl. the pickle sim-protocol server) fall back
+cleanly. Group coordination stays with the genuine client — the same
+division the reference draws by vendoring the unmodified rdkafka
+consumer in real mode."""
+
+import asyncio
+import os
+import struct
+
+import pytest
+
+from madsim_tpu.services.kafka import ErrorCode, KafkaError
+from madsim_tpu.services.kafka.real_client import (
+    _PROBE_CORRELATION_ID,
+    RealKafkaConn,
+    api_versions_frame,
+    probe_real_kafka,
+)
+
+
+def test_api_versions_frame_is_genuine_wire():
+    """Frame layout is the published Kafka protocol: int32 length,
+    int16 api_key=18, int16 version=0, int32 correlation, string id."""
+    f = api_versions_frame("probe")
+    (length,) = struct.unpack(">i", f[:4])
+    assert length == len(f) - 4
+    api_key, version, corr, id_len = struct.unpack(">hhih", f[4:14])
+    assert (api_key, version, corr) == (18, 0, _PROBE_CORRELATION_ID)
+    assert f[14:14 + id_len] == b"probe"
+
+
+def test_probe_detects_fake_broker_and_rejects_non_kafka():
+    async def main():
+        # a genuine-looking broker: echoes the correlation id back
+        async def broker(reader, writer):
+            head = await reader.readexactly(4)
+            (n,) = struct.unpack(">i", head)
+            body = await reader.readexactly(n)
+            _api, _ver, corr = struct.unpack(">hhi", body[:8])
+            writer.write(struct.pack(">ii", 4, corr))
+            await writer.drain()
+            writer.close()
+
+        srv = await asyncio.start_server(broker, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        ok = await probe_real_kafka("127.0.0.1", port)
+        srv.close()
+
+        # an HTTP-ish server is not a kafka broker
+        async def http(reader, writer):
+            await reader.readline()
+            writer.write(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+            await writer.drain()
+            writer.close()
+
+        srv2 = await asyncio.start_server(http, "127.0.0.1", 0)
+        port2 = srv2.sockets[0].getsockname()[1]
+        bad = await probe_real_kafka("127.0.0.1", port2)
+        srv2.close()
+
+        dead = await probe_real_kafka("127.0.0.1", 1)
+        return ok, bad, dead
+
+    ok, bad, dead = asyncio.run(main())
+    assert ok is True
+    assert bad is False
+    assert dead is False
+
+
+def test_real_conn_without_library_is_a_typed_error():
+    if _lib_installed():
+        pytest.skip("kafka-python installed; gating path not reachable")
+    with pytest.raises(KafkaError) as ei:
+        RealKafkaConn("127.0.0.1:9092")
+    assert ei.value.code == ErrorCode.INVALID_ARG
+    assert "kafka-python" in str(ei.value)
+
+
+def _lib_installed() -> bool:
+    try:
+        import kafka  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+@pytest.mark.skipif(
+    not (os.environ.get("KAFKA_BOOTSTRAP") and _lib_installed()),
+    reason="set KAFKA_BOOTSTRAP=host:port with kafka-python installed",
+)
+def test_against_genuine_kafka():
+    async def main():
+        host, _, port = os.environ["KAFKA_BOOTSTRAP"].rpartition(":")
+        assert await probe_real_kafka(host, int(port))
+        conn = RealKafkaConn(os.environ["KAFKA_BOOTSTRAP"])
+        try:
+            import uuid
+
+            topic = f"madsim-test-{uuid.uuid4().hex[:10]}"
+            await conn.call(("create_topic", topic, 1))
+            part, off = await conn.call(("produce", topic, 0, b"k", b"v", 0, None))
+            msgs = await conn.call(("fetch", topic, part, off, 10))
+            assert msgs and msgs[0].payload == b"v"
+        finally:
+            conn.close()
+        return True
+
+    assert asyncio.run(main())

@@ -1,0 +1,228 @@
+"""Real-client passthrough for S3 (VERDICT r2/r3 directive 1): in real
+mode `services.s3.Client` speaks the genuine S3 REST protocol (SigV4,
+XML) when the endpoint answers HTTP, falling back to the sim-protocol
+server otherwise — the analogue of madsim-aws-sdk-s3's non-sim build
+re-exporting the genuine SDK.
+
+The SigV4 signer is checked against AWS's published signature test
+vector; the wire itself is exercised in-process against `S3HttpGateway`
+(S3 REST served from the sim S3Service); a final test gated on
+S3_ENDPOINT runs against a genuine S3-compatible store."""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+from madsim_tpu.services.s3 import S3Error
+from madsim_tpu.services.s3.real_client import RealS3Backend, sigv4_sign
+from madsim_tpu.services.s3.real_gateway import S3HttpGateway
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_sigv4_matches_aws_published_vector():
+    """The `get-vanilla-query-order-key-case` example from AWS's SigV4
+    documentation/test suite (credentials AKIDEXAMPLE, service
+    'service', 2015-08-30) — a published constant, so any signer drift
+    fails loudly."""
+    auth = sigv4_sign(
+        "GET",
+        "/",
+        {"Param2": "value2", "Param1": "value1"},
+        {"host": "example.amazonaws.com", "x-amz-date": "20150830T123600Z"},
+        "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855",
+        access_key="AKIDEXAMPLE",
+        secret_key="wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+        region="us-east-1",
+        service="service",
+        amz_date="20150830T123600Z",
+    )
+    assert auth == (
+        "AWS4-HMAC-SHA256 "
+        "Credential=AKIDEXAMPLE/20150830/us-east-1/service/aws4_request, "
+        "SignedHeaders=host;x-amz-date, "
+        "Signature=b97d918cfa904a5beff61c982a1b6f458b799221646efd99d3219ec94cdf2500"
+    )
+
+
+def _run_against_gateway(workload):
+    async def main():
+        gw = S3HttpGateway()
+        port = await gw.start("127.0.0.1:0")
+        backend = RealS3Backend.from_env(f"http://127.0.0.1:{port}")
+        try:
+            return await workload(backend)
+        finally:
+            await gw.stop()
+
+    return asyncio.run(main())
+
+
+def test_object_lifecycle_over_real_wire():
+    async def wl(b):
+        await b.call("create_bucket", {"bucket": "bk"})
+        with pytest.raises(S3Error, match="BucketAlreadyExists"):
+            await b.call("create_bucket", {"bucket": "bk"})
+        put = await b.call("put_object", {
+            "bucket": "bk", "key": "a/x", "body": b"hello world",
+            "content_type": "text/plain", "metadata": {"owner": "t1"},
+        })
+        assert put["e_tag"]
+        got = await b.call("get_object", {"bucket": "bk", "key": "a/x"})
+        assert got["body"] == b"hello world"
+        assert got["content_type"] == "text/plain"
+        assert got["metadata"] == {"owner": "t1"}
+        assert got["e_tag"] == put["e_tag"]
+        rng = await b.call("get_object", {"bucket": "bk", "key": "a/x", "range": "bytes=6-10"})
+        assert rng["body"] == b"world"
+        assert rng["content_range"] == "bytes 6-10/11"
+        head = await b.call("head_object", {"bucket": "bk", "key": "a/x"})
+        assert head["content_length"] == 11 and "body" not in head
+        await b.call("copy_object", {
+            "src_bucket": "bk", "src_key": "a/x", "bucket": "bk", "key": "a/y",
+        })
+        assert (await b.call("get_object", {"bucket": "bk", "key": "a/y"}))["body"] == b"hello world"
+        with pytest.raises(S3Error, match="NoSuchKey"):
+            await b.call("get_object", {"bucket": "bk", "key": "missing"})
+        await b.call("delete_object", {"bucket": "bk", "key": "a/x"})
+        out = await b.call("delete_objects", {"bucket": "bk", "keys": ["a/y", "nope"]})
+        assert out["deleted"] == ["a/y"]
+        await b.call("delete_bucket", {"bucket": "bk"})
+        with pytest.raises(S3Error, match="NoSuchBucket"):
+            await b.call("get_object", {"bucket": "bk", "key": "a"})
+        return True
+
+    assert _run_against_gateway(wl)
+
+
+def test_awkward_keys_over_real_wire():
+    """Keys needing percent-encoding and XML escaping must round-trip:
+    the wire carries exactly the octets the signature canonicalized."""
+
+    async def wl(b):
+        await b.call("create_bucket", {"bucket": "odd"})
+        for k in ("my file.txt", "a&b<c>.bin", "pct%20literal"):
+            await b.call("put_object", {"bucket": "odd", "key": k, "body": k.encode()})
+            got = await b.call("get_object", {"bucket": "odd", "key": k})
+            assert got["body"] == k.encode(), k
+        out = await b.call("delete_objects", {"bucket": "odd", "keys": ["a&b<c>.bin"]})
+        assert out["deleted"] == ["a&b<c>.bin"]
+        lst = await b.call("list_objects_v2", {"bucket": "odd", "prefix": "my "})
+        assert [c["key"] for c in lst["contents"]] == ["my file.txt"]
+        return True
+
+    assert _run_against_gateway(wl)
+
+
+def test_listing_and_multipart_over_real_wire():
+    async def wl(b):
+        await b.call("create_bucket", {"bucket": "lst"})
+        for k in ("logs/1", "logs/2", "data/a", "data/sub/x", "top"):
+            await b.call("put_object", {"bucket": "lst", "key": k, "body": b"v"})
+        page = await b.call("list_objects_v2", {"bucket": "lst", "max_keys": 2})
+        assert page["is_truncated"] and page["key_count"] == 2
+        page2 = await b.call("list_objects_v2", {
+            "bucket": "lst", "continuation": page["next_continuation_token"],
+        })
+        all_keys = [c["key"] for c in page["contents"] + page2["contents"]]
+        assert all_keys == ["data/a", "data/sub/x", "logs/1", "logs/2", "top"]
+        rolled = await b.call("list_objects_v2", {"bucket": "lst", "delimiter": "/"})
+        assert [c["prefix"] for c in rolled["common_prefixes"]] == ["data/", "logs/"]
+        assert [c["key"] for c in rolled["contents"]] == ["top"]
+
+        mpu = await b.call("create_multipart_upload", {"bucket": "lst", "key": "big"})
+        uid = mpu["upload_id"]
+        await b.call("upload_part", {"upload_id": uid, "part_number": 2, "body": b"-two"})
+        await b.call("upload_part", {"upload_id": uid, "part_number": 1, "body": b"one"})
+        await b.call("complete_multipart_upload", {"upload_id": uid})
+        got = await b.call("get_object", {"bucket": "lst", "key": "big"})
+        assert got["body"] == b"one-two"
+
+        mpu2 = await b.call("create_multipart_upload", {"bucket": "lst", "key": "gone"})
+        await b.call("abort_multipart_upload", {"upload_id": mpu2["upload_id"]})
+        with pytest.raises(S3Error, match="NoSuchUpload"):
+            await b.call("upload_part", {
+                "upload_id": mpu2["upload_id"], "part_number": 1, "body": b"z",
+            })
+        return True
+
+    assert _run_against_gateway(wl)
+
+
+def test_lifecycle_config_over_real_wire():
+    async def wl(b):
+        await b.call("create_bucket", {"bucket": "lc"})
+        cfg = {"rules": [
+            {"id": "expire-logs", "prefix": "logs/", "days": 7},
+            {"id": "abort-mpu", "prefix": "", "abort_multipart_days": 2,
+             "status": "Disabled"},
+        ]}
+        await b.call("put_bucket_lifecycle_configuration", {"bucket": "lc", "config": cfg})
+        got = await b.call("get_bucket_lifecycle_configuration", {"bucket": "lc"})
+        assert got["rules"][0] == {
+            "id": "expire-logs", "status": "Enabled", "prefix": "logs/", "days": 7,
+        }
+        assert got["rules"][1]["status"] == "Disabled"
+        assert got["rules"][1]["abort_multipart_days"] == 2
+        return True
+
+    assert _run_against_gateway(wl)
+
+
+def test_real_mode_client_probes_http_and_falls_back():
+    """Public path: in real mode `services.s3.Client` probes the
+    endpoint; an HTTP answer -> REST passthrough (the sim fluent API
+    runs against the genuine wire)."""
+    code = f"""
+import asyncio, sys
+sys.path.insert(0, {REPO!r})
+from madsim_tpu.services.s3 import Client, Config
+from madsim_tpu.services.s3.real_gateway import S3HttpGateway
+
+async def main():
+    gw = S3HttpGateway()
+    port = await gw.start("127.0.0.1:0")
+    client = Client.from_conf(Config(endpoint_url=f"http://127.0.0.1:{{port}}"))
+    await client.create_bucket().bucket("apps").send()
+    await client.put_object().bucket("apps").key("cfg").body(b"real-wire").send()
+    got = await client.get_object().bucket("apps").key("cfg").send()
+    assert client._real is not None, "expected REST passthrough"
+    print("BODY:", got["body"].decode())
+    await gw.stop()
+
+asyncio.run(main())
+"""
+    env = dict(os.environ)
+    env["MADSIM_TPU_MODE"] = "real"
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True, timeout=120
+    )
+    assert out.returncode == 0, out.stderr
+    assert "BODY: real-wire" in out.stdout
+
+
+@pytest.mark.skipif(
+    not os.environ.get("S3_ENDPOINT"),
+    reason="set S3_ENDPOINT=http://host:port (+AWS_* creds) for a genuine store",
+)
+def test_against_genuine_s3():
+    async def main():
+        import uuid
+
+        b = RealS3Backend.from_env(os.environ["S3_ENDPOINT"])
+        bucket = f"madsim-test-{uuid.uuid4().hex[:12]}"
+        await b.call("create_bucket", {"bucket": bucket})
+        try:
+            await b.call("put_object", {"bucket": bucket, "key": "k", "body": b"v"})
+            got = await b.call("get_object", {"bucket": bucket, "key": "k"})
+            assert got["body"] == b"v"
+        finally:
+            await b.call("delete_object", {"bucket": bucket, "key": "k"})
+            await b.call("delete_bucket", {"bucket": bucket})
+        return True
+
+    assert asyncio.run(main())
