@@ -184,6 +184,10 @@ class RealWatcher:
             rsp = await self._stream.message()
             if rsp is None:
                 raise EtcdError("watch stream closed")
+            if rsp.canceled:
+                raise EtcdError(
+                    f"watch canceled by server: {rsp.cancel_reason or 'unknown'}"
+                )
             evs = self._translate(rsp)
             if evs:
                 self._pending.extend(evs)

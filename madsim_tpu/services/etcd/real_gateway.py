@@ -208,25 +208,32 @@ class _Watch(_Base):
     async def watch(self, stream):
         """One queue carries both client requests and store events, so
         there is a single await point (no racy cancellation of a
-        half-consumed request iterator)."""
+        half-consumed request iterator). Watches multiplex over the
+        stream keyed by watch_id, like genuine etcd: each
+        create_request gets its own id (client-chosen via
+        WatchCreateRequest.watch_id or server-assigned), events carry
+        it, and cancel_request tears down only that watch."""
         ns = self.ns
         q: asyncio.Queue = asyncio.Queue()
-        entry = None
-        filters = set()
-        want_prev = False
+        # watch_id -> (svc watcher entry, filters, want_prev)
+        watches: dict = {}
+        next_id = [1]
 
         async def reader():
             while True:
                 req = await stream.message()
-                q.put_nowait(("req", req))
+                q.put_nowait(("req", req, None))
                 if req is None:
                     return
 
         rt = asyncio.ensure_future(reader())
         try:
             while True:
-                tag, item = await q.get()
+                tag, item, wid = await q.get()
                 if tag == "ev":
+                    if wid not in watches:
+                        continue  # canceled while queued
+                    _entry, filters, want_prev = watches[wid]
                     ev = item
                     if ev.kind == Event.PUT and 0 in filters:
                         continue
@@ -237,7 +244,7 @@ class _Watch(_Base):
                     )
                     if want_prev and ev.prev_kv is not None:
                         pb.prev_kv.CopyFrom(self.kv_pb(ev.prev_kv))
-                    yield ns.WatchResponse(header=self.hdr(), events=[pb])
+                    yield ns.WatchResponse(header=self.hdr(), watch_id=wid, events=[pb])
                     continue
                 req = item
                 if req is None:
@@ -245,8 +252,8 @@ class _Watch(_Base):
                 which = req.WhichOneof("request_union")
                 if which == "create_request":
                     c = req.create_request
-                    filters = set(c.filters)
-                    want_prev = c.prev_kv
+                    wid = c.watch_id or next_id[0]
+                    next_id[0] = max(next_id[0], wid) + 1
                     lo, hi = bytes(c.key), bytes(c.range_end)
                     backlog = []
                     if c.start_revision:
@@ -254,24 +261,35 @@ class _Watch(_Base):
                             backlog = self.svc.history_since(c.start_revision, lo, hi)
                         except EtcdError:
                             yield ns.WatchResponse(
-                                header=self.hdr(), canceled=True,
+                                header=self.hdr(), watch_id=wid, canceled=True,
                                 compact_revision=max(
                                     self.svc.compact_revision, self.svc.history_floor, 1
                                 ),
                             )
-                            return
-                    yield ns.WatchResponse(header=self.hdr(), created=True)
+                            continue
+                    # snapshot -> register -> THEN yield: the yield
+                    # suspends this generator (other tasks may mutate the
+                    # store), so the watcher must exist before it or
+                    # events in that window would be lost. No awaits
+                    # between history_since and add_watcher => no gap,
+                    # no duplicate.
+                    entry = self.svc.add_watcher(
+                        lo, hi, lambda ev, w=wid: q.put_nowait(("ev", ev, w))
+                    )
+                    watches[wid] = (entry, set(c.filters), c.prev_kv)
                     for ev in backlog:
-                        q.put_nowait(("ev", ev))
-                    entry = self.svc.add_watcher(lo, hi, lambda ev: q.put_nowait(("ev", ev)))
+                        q.put_nowait(("ev", ev, wid))
+                    yield ns.WatchResponse(header=self.hdr(), watch_id=wid, created=True)
                 elif which == "progress_request":
-                    yield ns.WatchResponse(header=self.hdr())
+                    yield ns.WatchResponse(header=self.hdr(), watch_id=-1)
                 elif which == "cancel_request":
-                    yield ns.WatchResponse(header=self.hdr(), canceled=True)
-                    return
+                    wid = req.cancel_request.watch_id
+                    if wid in watches:
+                        self.svc.remove_watcher(watches.pop(wid)[0])
+                    yield ns.WatchResponse(header=self.hdr(), watch_id=wid, canceled=True)
         finally:
             rt.cancel()
-            if entry is not None:
+            for entry, _f, _p in watches.values():
                 self.svc.remove_watcher(entry)
 
 

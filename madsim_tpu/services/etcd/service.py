@@ -313,9 +313,18 @@ class EtcdService:
     def tick(self) -> None:
         """1-second lease countdown (reference: service.rs:25-35 spawned
         tick task; expiry deletes attached keys)."""
+        self.advance(1)
+
+    def advance(self, n: int) -> None:
+        """`n` ticks at once — lease accounting is linear in elapsed
+        time, so this equals n sequential tick() calls. Used by the
+        service-differential harness as its virtual-time bridge
+        (differential_services.py: 1 machine µs = 1 tick)."""
+        if n <= 0:
+            return
         expired = []
         for lease_id, pair in self.leases.items():
-            pair[1] -= 1
+            pair[1] -= n
             if pair[1] <= 0:
                 expired.append(lease_id)
         for lease_id in expired:

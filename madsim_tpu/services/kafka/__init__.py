@@ -549,6 +549,14 @@ class _Conn:
                    "committed", "commit_offsets", "heartbeat", "sync_group",
                    "leave_group", "describe_group"}
 
+    def close(self) -> None:
+        """Release the backend: genuine-lib clients (sockets + their
+        background threads) or the sim-protocol stream fd."""
+        if self._real is not None:
+            self._real.close()
+            self._real = None
+        self._caller.close()
+
     async def call(self, req: tuple):
         if self._real is not None:
             return await self._real.call(req)
@@ -600,6 +608,10 @@ class BaseProducer:
         # any broker round trip (config: message.max.bytes)
         p._max_bytes = int(cfg.get("message.max.bytes", "1000000"))
         return p
+
+    def close(self) -> None:
+        """Release the connection (genuine-lib clients or the sim fd)."""
+        self._conn.close()
 
     def _check_size(self, record: BaseRecord) -> None:
         size = len(record.key or b"") + len(record.payload or b"")
@@ -673,6 +685,10 @@ class FutureProducer:
 
     def __init__(self) -> None:
         self._inner: Optional[BaseProducer] = None
+
+    def close(self) -> None:
+        if self._inner is not None:
+            self._inner.close()
 
     def send(self, record: BaseRecord, timeout: Optional[float] = None) -> DeliveryFuture:
         async def deliver():
@@ -766,6 +782,7 @@ class BaseConsumer:
             except KafkaError:
                 pass  # mid-rebalance: the new owner resumes from the last commit
         await self.unsubscribe()
+        self._conn.close()
 
     # -- group protocol plumbing (poll-driven, like rdkafka) --
 
@@ -934,6 +951,9 @@ class AdminClient:
         a = AdminClient()
         await a._conn.open(cfg._addr())
         return a
+
+    def close(self) -> None:
+        self._conn.close()
 
     async def create_topics(self, topics: Sequence[NewTopic]) -> List[Tuple[str, Optional[str]]]:
         """Per-topic results, rdkafka-style: (name, None) on success or

@@ -76,6 +76,8 @@ class RealKafkaConn:
     _UNSUPPORTED = {"join_group", "sync_group", "heartbeat", "leave_group"}
 
     def __init__(self, bootstrap: str):
+        import threading
+
         kafka = _genuine_lib()
         if kafka is None:
             raise KafkaError(
@@ -90,6 +92,10 @@ class RealKafkaConn:
         self._producer = None
         self._consumers: Dict[Optional[str], object] = {}
         self._admin = None
+        # kafka-python clients are NOT thread-safe; asyncio.to_thread can
+        # run concurrent calls on different worker threads, so the whole
+        # data plane is serialized per connection
+        self._lock = threading.Lock()
 
     # lazily built per role; all blocking calls hop to a worker thread
     def _get_producer(self):
@@ -120,7 +126,11 @@ class RealKafkaConn:
                 "client's group consumer in production",
                 ErrorCode.INVALID_ARG,
             )
-        return await asyncio.to_thread(self._call_sync, kind, req)
+        return await asyncio.to_thread(self._call_locked, kind, req)
+
+    def _call_locked(self, kind: str, req: tuple):
+        with self._lock:
+            return self._call_sync(kind, req)
 
     def _call_sync(self, kind: str, req: tuple):
         kafka = self._kafka
@@ -192,9 +202,13 @@ class RealKafkaConn:
         raise KafkaError(f"unknown request {kind}", ErrorCode.INVALID_ARG)
 
     def close(self) -> None:
-        if self._producer is not None:
-            self._producer.close()
-        for c in self._consumers.values():
-            c.close()
-        if self._admin is not None:
-            self._admin.close()
+        with self._lock:
+            if self._producer is not None:
+                self._producer.close()
+                self._producer = None
+            for c in self._consumers.values():
+                c.close()
+            self._consumers.clear()
+            if self._admin is not None:
+                self._admin.close()
+                self._admin = None

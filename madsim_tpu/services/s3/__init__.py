@@ -398,6 +398,15 @@ class Client:
             return lambda: _FluentOp(self, name)
         raise AttributeError(name)
 
+    async def close(self) -> None:
+        """Release the backend (REST keep-alive connection or sim fd)."""
+        if self._real is not None:
+            self._real.close()
+            self._real = None
+        if self._caller is not None:
+            self._caller.close()
+            self._caller = None
+
     async def _call(self, op: str, params: Dict[str, Any]):
         if self._caller is None and self._real is None:
             from ...dual import IS_SIM, real_passthrough_enabled

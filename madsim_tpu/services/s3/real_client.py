@@ -134,6 +134,8 @@ class RealS3Backend:
     def __init__(self, host: str, port: int, *, access_key: str, secret_key: str,
                  region: str, session_token: Optional[str] = None, timeout: float = 10.0,
                  tls: bool = False):
+        import threading
+
         self.host = host
         self.port = port
         self.tls = tls
@@ -142,6 +144,11 @@ class RealS3Backend:
         self.session_token = session_token
         self.region = region
         self.timeout = timeout
+        # one cached keep-alive connection, serialized: http.client
+        # connections are not thread-safe and asyncio.to_thread may run
+        # requests on different worker threads
+        self._conn_lock = threading.Lock()
+        self._conn = None
 
     @classmethod
     def from_env(cls, endpoint_url: str, timeout: float = 10.0) -> "RealS3Backend":
@@ -185,17 +192,31 @@ class RealS3Backend:
         qs = "&".join(
             f"{_uri_encode(k)}={_uri_encode(str(v))}" for k, v in sorted(query.items())
         )
+        target = enc_path + (f"?{qs}" if qs else "")
         conn_cls = http.client.HTTPSConnection if self.tls else http.client.HTTPConnection
-        conn = conn_cls(self.host, self.port, timeout=self.timeout)
-        try:
-            conn.request(
-                method, enc_path + (f"?{qs}" if qs else ""), body=body or None, headers=h
-            )
-            rsp = conn.getresponse()
-            data = rsp.read()
-            return rsp.status, {k.lower(): v for k, v in rsp.getheaders()}, data
-        finally:
-            conn.close()
+        with self._conn_lock:
+            # keep-alive reuse; a stale cached connection (server closed
+            # it between requests) gets one reconnect
+            for attempt in (0, 1):
+                if self._conn is None:
+                    self._conn = conn_cls(self.host, self.port, timeout=self.timeout)
+                try:
+                    self._conn.request(method, target, body=body or None, headers=h)
+                    rsp = self._conn.getresponse()
+                    data = rsp.read()
+                    return rsp.status, {k.lower(): v for k, v in rsp.getheaders()}, data
+                except (http.client.HTTPException, ConnectionError, BrokenPipeError):
+                    self._conn.close()
+                    self._conn = None
+                    if attempt:
+                        raise
+            raise AssertionError("unreachable")
+
+    def close(self) -> None:
+        with self._conn_lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
 
     async def _request(self, method: str, path: str, query=None, headers=None,
                        body: bytes = b"") -> Tuple[int, Dict[str, str], bytes]:
@@ -496,5 +517,9 @@ async def probe_real_s3(endpoint_url: str, timeout: float = 2.0) -> Optional[Rea
     # any well-formed HTTP answer (200 list, 403 bad creds page, …)
     # means there is an HTTP server here, not the pickle sim protocol
     if 100 <= st <= 599:
+        # the short PROBE deadline must not become the per-request
+        # socket timeout for real operations (etcd learned this too)
+        backend.timeout = 30.0
+        backend.close()  # drop the probe-deadline connection
         return backend
     return None

@@ -55,6 +55,9 @@ class S3HttpGateway:
         self.lifecycle_interval = lifecycle_interval
         self._server: Optional[asyncio.AbstractServer] = None
         self._lc_task: Optional[asyncio.Task] = None
+        # open keep-alive connections; stop() must close them or
+        # wait_closed() blocks on their handlers (3.12 semantics)
+        self._writers: set = set()
 
     async def start(self, addr: str = "127.0.0.1:0") -> int:
         host, _, port = addr.rpartition(":")
@@ -79,6 +82,8 @@ class S3HttpGateway:
     async def stop(self) -> None:
         if self._lc_task is not None:
             self._lc_task.cancel()
+        for w in list(self._writers):
+            w.close()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -86,6 +91,7 @@ class S3HttpGateway:
     # -- HTTP plumbing --------------------------------------------------------
 
     async def _conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._writers.add(writer)
         try:
             while True:
                 line = await reader.readline()
@@ -117,6 +123,7 @@ class S3HttpGateway:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._writers.discard(writer)
             writer.close()
 
     def _error(self, e: S3Error) -> Tuple[int, Dict[str, str], bytes]:

@@ -137,6 +137,10 @@ class Executor:
     def __init__(self, rng: GlobalRng, time: TimeHandle):
         self.rng = rng
         self.time = time
+        # draw-hash observation folds in the virtual clock (the native
+        # twin of _context.try_time_ns in GlobalRng._record)
+        if rng._core is not None and time._core is not None:
+            rng._core.bind_time(time._core)
         self.ready: List[TaskEntry] = []
         self.nodes: Dict[int, NodeInfo] = {}
         self._next_node_id = MAIN_NODE_ID
@@ -259,9 +263,12 @@ class Executor:
                 mod is not None
                 and rng._core is not None
                 and self.time._core is not None
-                and not rng.recording
+                and (not rng.recording or rng.native_observing)
             ):
-                # the whole inner loop (drain + timer jump) runs in C
+                # the whole inner loop (drain + timer jump) runs in C;
+                # in check mode the core itself hashes every draw
+                # (scheduling draws included), so the loop users run is
+                # the loop the check validates (VERDICT r2/r3 item)
                 code = mod.drive(
                     self, _context.current(), rng._core, self.time._core, main_task
                 )
@@ -290,6 +297,8 @@ class Executor:
                     f"time limit ({self.time_limit_ns / SEC}s) exceeded at "
                     f"t={self.time.elapsed()}s"
                 )
+            if code == 4:
+                self.rng.raise_native_mismatch()
             raise Deadlock(
                 "all tasks are blocked and no timer is pending — "
                 "the simulation would block forever (deadlock)"
@@ -303,7 +312,7 @@ class Executor:
             mod is not None
             and rng._core is not None
             and self.time._core is not None
-            and not rng.recording
+            and (not rng.recording or rng.native_observing)
         ):
             mod.run_all_ready(self, _context.current(), rng._core, self.time._core)
             return

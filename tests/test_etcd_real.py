@@ -162,6 +162,66 @@ def test_watch_over_real_wire():
     assert _run_against_gateway(wl)
 
 
+def test_watch_stream_multiplexes_by_watch_id():
+    """Genuine etcd clients multiplex many watches over ONE Watch
+    stream keyed by watch_id; the gateway must route events and cancels
+    per id (a genuine client would otherwise misroute every event)."""
+
+    async def main():
+        import asyncio
+
+        from madsim_tpu.services.etcd.real_client import protos
+        from madsim_tpu.grpc.real import RealChannel
+
+        ns = protos()
+        gw = EtcdGrpcGateway()
+        port = await gw.start("127.0.0.1:0")
+        from madsim_tpu.services.etcd.real_client import _merged_methods
+
+        ch = await RealChannel.connect(f"127.0.0.1:{port}", _merged_methods(ns))
+        ch.set_default_timeout(None)
+        kv_put = lambda k, v: ch.unary(  # noqa: E731
+            "/etcdserverpb.KV/Put", ns.PutRequest(key=k, value=v)
+        )
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def reqs():
+            while (item := await q.get()) is not None:
+                yield item
+
+        await q.put(ns.WatchRequest(create_request=ns.WatchCreateRequest(
+            key=b"a/", range_end=b"a0", watch_id=7)))
+        stream = await ch.streaming("/etcdserverpb.Watch/Watch", reqs())
+        created1 = await stream.message()
+        assert (created1.created, created1.watch_id) == (True, 7)
+        await q.put(ns.WatchRequest(create_request=ns.WatchCreateRequest(
+            key=b"b/", range_end=b"b0", watch_id=9)))
+        created2 = await stream.message()
+        assert (created2.created, created2.watch_id) == (True, 9)
+
+        await kv_put(b"a/1", b"x")
+        await kv_put(b"b/1", b"y")
+        ev1 = await stream.message()
+        ev2 = await stream.message()
+        routed = {(r.watch_id, bytes(r.events[0].kv.key)) for r in (ev1, ev2)}
+        assert routed == {(7, b"a/1"), (9, b"b/1")}
+
+        # cancel ONLY watch 7; watch 9 must keep delivering
+        await q.put(ns.WatchRequest(cancel_request=ns.WatchCancelRequest(watch_id=7)))
+        canceled = await stream.message()
+        assert (canceled.canceled, canceled.watch_id) == (True, 7)
+        await kv_put(b"a/2", b"x2")
+        await kv_put(b"b/2", b"y2")
+        ev3 = await stream.message()
+        assert (ev3.watch_id, bytes(ev3.events[0].kv.key)) == (9, b"b/2")
+        await q.put(None)
+        await ch.close()
+        await gw.stop()
+        return True
+
+    assert asyncio.run(main())
+
+
 def test_election_over_real_wire():
     async def wl(client, gw):
         lease = await client.lease_grant(60)

@@ -1,0 +1,114 @@
+"""Native-loop draw observation for check mode (VERDICT r2/r3 item,
+3rd ask): MADSIM_TEST_CHECK_DETERMINISM must validate the loop users
+actually run. With the native core present, every draw — including the
+C drive loop's internal scheduling/advance draws — is hashed inside
+hostcore (splitmix64((idx << 32) ^ value ^ now_ns), the twin of
+GlobalRng._record / reference sim/rand.rs:65-90), so check mode keeps
+the native loop engaged instead of routing to the Python loop."""
+
+import pytest
+
+from madsim_tpu import _native
+from madsim_tpu import rand as sim_rand
+from madsim_tpu import time as sim_time
+from madsim_tpu.errors import NonDeterminism
+from madsim_tpu.runtime import Handle, Runtime
+from madsim_tpu.task import spawn
+
+native = pytest.mark.skipif(not _native.available(), reason="no native toolchain")
+
+
+async def _workload():
+    rng = sim_rand.thread_rng()
+    out = []
+
+    async def worker(i):
+        await sim_time.sleep(rng.random() * 0.01)
+        out.append((i, rng.gen_range(0, 1000)))
+
+    handle = Handle.current()
+    node = handle.create_node().build()
+    for i in range(4):
+        node.spawn(worker(i))
+    await sim_time.sleep(0.1)
+    return tuple(out)
+
+
+@native
+def test_check_mode_keeps_native_loop_engaged():
+    """enable_log with a native core activates core observation and the
+    executor's condition keeps mod.drive selected (the whole point)."""
+    rt = Runtime(seed=5)
+    rt.rng.enable_log()
+    assert rt.rng.native_observing
+    assert rt.rng.recording
+    r = rt.block_on(_workload())
+    log = rt.rng.take_log()
+    assert len(log) > 0
+    assert not rt.rng.native_observing
+    assert len(r) == 4
+
+
+@native
+def test_native_and_python_observation_hash_identically():
+    """The native core's draw hashes equal the Python _record hashes for
+    the same seed/workload — so a log taken on one loop checks the
+    other (cross-loop determinism contract)."""
+    rt1 = Runtime(seed=9)
+    rt1.rng.enable_log()
+    r1 = rt1.block_on(_workload())
+    native_log = rt1.rng.take_log()
+
+    # a runtime with the native core disabled from birth (construction
+    # itself draws — the random wall-clock base — so the stream must be
+    # pure-Python from word 0)
+    old_available = _native.available
+    try:
+        _native.available = lambda: False
+        rt2 = Runtime(seed=9)
+    finally:
+        _native.available = old_available
+    assert rt2.rng._core is None
+    rt2.rng.enable_log()
+    r2 = rt2.block_on(_workload())
+    python_log = rt2.rng.take_log()
+
+    assert r1 == r2
+    assert native_log == python_log
+
+
+@native
+def test_native_check_passes_clean_and_catches_planted_nondeterminism():
+    # clean workload: two native-loop runs agree draw-for-draw
+    assert Runtime.check_determinism(11, _workload) is not None
+
+    # planted nondeterminism: the second run draws differently
+    calls = [0]
+
+    async def flaky():
+        calls[0] += 1
+        rng = sim_rand.thread_rng()
+        n = 3 if calls[0] == 1 else 4
+        vals = [rng.next_u32() for _ in range(n)]
+        await sim_time.sleep(0.01)
+        return len(vals)
+
+    with pytest.raises(NonDeterminism):
+        Runtime.check_determinism(12, flaky)
+
+
+@native
+def test_native_check_catches_schedule_divergence_details():
+    """The mismatch message carries draw index + sim time, like the
+    Python path and the reference's panic (sim/rand.rs:65-90)."""
+    calls = [0]
+
+    async def skew():
+        calls[0] += 1
+        rng = sim_rand.thread_rng()
+        if calls[0] > 1:
+            rng.next_u32()  # one extra draw shifts every later hash
+        return await _workload()
+
+    with pytest.raises(NonDeterminism, match="draw #"):
+        Runtime.check_determinism(13, skew)
