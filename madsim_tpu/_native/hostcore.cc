@@ -25,6 +25,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <cstring>
 #include <vector>
 
 namespace {
@@ -319,6 +320,15 @@ inline int64_t obs_now_ns(RngObject* r) {
 
 static PyObject* Rng_bind_time(PyObject* self, PyObject* arg) {
   RngObject* r = reinterpret_cast<RngObject*>(self);
+  // tp_name check (TimeCoreType's definition is below this point in the
+  // file, so PyObject_TypeCheck can't be used here): an arbitrary
+  // object would be reinterpreted as TimeCoreObject and read garbage
+  if (arg != Py_None &&
+      strcmp(Py_TYPE(arg)->tp_name, "hostcore.TimeCore") != 0) {
+    PyErr_Format(PyExc_TypeError, "bind_time expects a TimeCore or None, got %s",
+                 Py_TYPE(arg)->tp_name);
+    return nullptr;
+  }
   Py_XDECREF(reinterpret_cast<PyObject*>(r->time_src));
   r->time_src = nullptr;
   if (arg != Py_None) {

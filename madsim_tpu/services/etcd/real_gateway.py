@@ -253,6 +253,14 @@ class _Watch(_Base):
                 if which == "create_request":
                     c = req.create_request
                     wid = c.watch_id or next_id[0]
+                    if wid in watches:
+                        # real etcd: re-using a live id cancels the
+                        # request, never silently replaces the watcher
+                        yield ns.WatchResponse(
+                            header=self.hdr(), watch_id=wid, canceled=True,
+                            cancel_reason="watcher with ID exists",
+                        )
+                        continue
                     next_id[0] = max(next_id[0], wid) + 1
                     lo, hi = bytes(c.key), bytes(c.range_end)
                     backlog = []

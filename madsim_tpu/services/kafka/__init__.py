@@ -551,11 +551,25 @@ class _Conn:
 
     def close(self) -> None:
         """Release the backend: genuine-lib clients (sockets + their
-        background threads) or the sim-protocol stream fd."""
-        if self._real is not None:
-            self._real.close()
-            self._real = None
+        background threads) or the sim-protocol stream fd.
+
+        Genuine-client teardown does network I/O (leave-group, flush)
+        and contends with in-flight calls on the data-plane lock, so on
+        a running event loop it is offloaded to a daemon thread instead
+        of freezing every coroutine."""
+        real, self._real = self._real, None
         self._caller.close()
+        if real is None:
+            return
+        import asyncio
+        import threading
+
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            real.close()
+            return
+        threading.Thread(target=real.close, daemon=True).start()
 
     async def call(self, req: tuple):
         if self._real is not None:

@@ -399,10 +399,19 @@ class Client:
         raise AttributeError(name)
 
     async def close(self) -> None:
-        """Release the backend (REST keep-alive connection or sim fd)."""
+        """Release the backend (REST keep-alive connection or sim fd).
+        The REST close contends with in-flight requests on the
+        connection lock, so it runs off the event loop."""
         if self._real is not None:
-            self._real.close()
-            self._real = None
+            real, self._real = self._real, None
+            from ...dual import IS_SIM
+
+            if IS_SIM:
+                real.close()
+            else:
+                import asyncio
+
+                await asyncio.to_thread(real.close)
         if self._caller is not None:
             self._caller.close()
             self._caller = None

@@ -194,21 +194,30 @@ class RealS3Backend:
         )
         target = enc_path + (f"?{qs}" if qs else "")
         conn_cls = http.client.HTTPSConnection if self.tls else http.client.HTTPConnection
+        idempotent = method in ("GET", "HEAD")
         with self._conn_lock:
             # keep-alive reuse; a stale cached connection (server closed
-            # it between requests) gets one reconnect
+            # it between requests) gets one reconnect — but only when the
+            # failure is provably pre-response: a SEND-time error always
+            # (the server saw nothing complete), a response-time error
+            # only for idempotent reads. A mutation whose response was
+            # lost is ambiguous (the server may have applied it) and is
+            # surfaced, never blindly re-sent — the retry discipline
+            # services/_conn.py:32-37 documents for the sim protocol.
             for attempt in (0, 1):
                 if self._conn is None:
                     self._conn = conn_cls(self.host, self.port, timeout=self.timeout)
+                sent = False
                 try:
                     self._conn.request(method, target, body=body or None, headers=h)
+                    sent = True
                     rsp = self._conn.getresponse()
                     data = rsp.read()
                     return rsp.status, {k.lower(): v for k, v in rsp.getheaders()}, data
                 except (http.client.HTTPException, ConnectionError, BrokenPipeError):
                     self._conn.close()
                     self._conn = None
-                    if attempt:
+                    if attempt or (sent and not idempotent):
                         raise
             raise AssertionError("unreachable")
 
