@@ -97,12 +97,20 @@ class RaftNode:
         await sim_time.sleep(1e9)
 
     async def ticker(self, ep):
+        """Event-driven: a leader beats on a fixed cadence; everyone else
+        sleeps exactly until the election deadline (handlers move the
+        deadline; waking at a stale one just re-sleeps) — no 20 ms
+        polling, ~7x fewer timer events per simulated second."""
         while True:
-            await sim_time.sleep(0.02)
             if self.role == LEADER:
                 await self.heartbeat(ep)
-            elif sim_time.now() >= self.election_deadline:
-                await self.campaign(ep)
+                await sim_time.sleep(0.05)
+                continue
+            delta = self.election_deadline - sim_time.now()
+            if delta > 1e-6:  # float dust would arm a zero-delay timer spin
+                await sim_time.sleep(delta)
+                continue
+            await self.campaign(ep)
 
     async def campaign(self, ep):
         self.term += 1

@@ -629,6 +629,8 @@ static PyObject* g_pending = nullptr;       // future.PENDING
 static PyObject* g_ready_none = nullptr;    // shared Ready(None)
 static PyObject* g_current_task_fn = nullptr;  // _context.current_task
 
+static PyObject* g_ready_cls = nullptr;     // future.Ready (for Ready(value))
+
 static int ensure_future_imports() {
   if (g_pending) return 0;
   PyObject* fut = PyImport_ImportModule("madsim_tpu.future");
@@ -641,8 +643,11 @@ static int ensure_future_imports() {
     return -1;
   }
   g_ready_none = PyObject_CallOneArg(ready_cls, Py_None);
-  Py_DECREF(ready_cls);
-  if (!g_ready_none) return -1;
+  if (!g_ready_none) {
+    Py_DECREF(ready_cls);
+    return -1;
+  }
+  g_ready_cls = ready_cls;  // keep: mailbox polls build Ready(msg)
   PyObject* ctxmod = PyImport_ImportModule("madsim_tpu._context");
   if (!ctxmod) return -1;
   g_current_task_fn = PyObject_GetAttrString(ctxmod, "current_task");
@@ -808,6 +813,241 @@ static PyTypeObject AwaitIterType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
     "hostcore.AwaitIter",      /* tp_name */
     sizeof(AwaitIterObject),   /* tp_basicsize */
+};
+
+// ---------------------------------------------------------------------------
+// Mailbox — native tag-matched mailbox + its recv pollable
+// (semantics of net/endpoint.py Mailbox/_MailboxRecv, reference:
+// endpoint.rs:298-352). One C object replaces the OneShotCell +
+// _MailboxRecv + recv_cell stack on the RPC hot path: deliver matches
+// the FIRST registered receiver for the tag (FIFO), unmatched messages
+// buffer FIFO, recv(tag) scans the buffer then registers eagerly at
+// CALL time (before the first poll — a message delivered between
+// recv() and the await must not be missed), and drop() deregisters so
+// an aborted receiver (timed-out RPC) cannot swallow a later message.
+// ---------------------------------------------------------------------------
+
+struct MailRecvObject;
+
+struct MailboxObject {
+  PyObject_HEAD
+  // (tag, Message) buffered FIFO; strong refs
+  std::vector<std::pair<uint64_t, PyObject*>>* msgs;
+  // (tag, receiver) registered FIFO; strong refs
+  std::vector<std::pair<uint64_t, MailRecvObject*>>* reg;
+};
+
+struct MailRecvObject {
+  PyObject_HEAD
+  MailboxObject* mb;  // strong
+  uint64_t tag;
+  PyObject* value;  // strong; nullptr = pending
+  PyObject* waker;  // strong; last poll's waker
+  char done;
+  char registered;
+};
+
+static PyTypeObject MailboxType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "hostcore.Mailbox",        /* tp_name */
+    sizeof(MailboxObject),     /* tp_basicsize */
+};
+
+static PyTypeObject MailRecvType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "hostcore.MailRecv",       /* tp_name */
+    sizeof(MailRecvObject),    /* tp_basicsize */
+};
+
+static PyObject* Mailbox_new(PyTypeObject* type, PyObject*, PyObject*) {
+  MailboxObject* self = reinterpret_cast<MailboxObject*>(type->tp_alloc(type, 0));
+  if (!self) return nullptr;
+  self->msgs = new std::vector<std::pair<uint64_t, PyObject*>>();
+  self->reg = new std::vector<std::pair<uint64_t, MailRecvObject*>>();
+  return reinterpret_cast<PyObject*>(self);
+}
+
+static int Mailbox_traverse(PyObject* self, visitproc visit, void* arg) {
+  MailboxObject* m = reinterpret_cast<MailboxObject*>(self);
+  if (m->msgs) {
+    for (auto& p : *m->msgs) Py_VISIT(p.second);
+  }
+  if (m->reg) {
+    for (auto& p : *m->reg) Py_VISIT(reinterpret_cast<PyObject*>(p.second));
+  }
+  return 0;
+}
+
+static int Mailbox_clear_gc(PyObject* self) {
+  MailboxObject* m = reinterpret_cast<MailboxObject*>(self);
+  if (m->msgs) {
+    // swap out first: a msg dealloc re-entering this mailbox must see
+    // an empty buffer, not a half-cleared vector
+    std::vector<std::pair<uint64_t, PyObject*>> msgs;
+    msgs.swap(*m->msgs);
+    for (auto& p : msgs) Py_CLEAR(p.second);
+  }
+  if (m->reg) {
+    // swap out BEFORE decref: dropping a receiver's last ref runs
+    // MailRecv_dealloc -> mailrecv_deregister, which must not find the
+    // entry still in m->reg (it would erase mid-iteration and decref a
+    // mid-dealloc object)
+    std::vector<std::pair<uint64_t, MailRecvObject*>> reg;
+    reg.swap(*m->reg);
+    for (auto& p : reg) {
+      MailRecvObject* r = p.second;
+      p.second = nullptr;
+      if (r) {
+        r->registered = 0;
+        Py_DECREF(reinterpret_cast<PyObject*>(r));
+      }
+    }
+  }
+  return 0;
+}
+
+static void Mailbox_dealloc(PyObject* self) {
+  MailboxObject* m = reinterpret_cast<MailboxObject*>(self);
+  PyObject_GC_UnTrack(self);
+  Mailbox_clear_gc(self);
+  delete m->msgs;
+  delete m->reg;
+  m->msgs = nullptr;
+  m->reg = nullptr;
+  Py_TYPE(self)->tp_free(self);
+}
+
+static PyObject* s_tag;  // interned "tag" (init at module load)
+
+static PyObject* Mailbox_deliver(PyObject* self, PyObject* msg) {
+  MailboxObject* m = reinterpret_cast<MailboxObject*>(self);
+  PyObject* tag_o = PyObject_GetAttr(msg, s_tag);
+  if (!tag_o) return nullptr;
+  uint64_t tag = PyLong_AsUnsignedLongLong(tag_o);
+  Py_DECREF(tag_o);
+  if (tag == static_cast<uint64_t>(-1) && PyErr_Occurred()) return nullptr;
+  for (size_t i = 0; i < m->reg->size(); ++i) {
+    if ((*m->reg)[i].first != tag) continue;
+    MailRecvObject* r = (*m->reg)[i].second;
+    m->reg->erase(m->reg->begin() + static_cast<long>(i));
+    r->registered = 0;
+    Py_INCREF(msg);
+    r->value = msg;
+    PyObject* ret = r->waker ? PyObject_CallNoArgs(r->waker) : nullptr;
+    if (r->waker && !ret) {
+      Py_DECREF(reinterpret_cast<PyObject*>(r));
+      return nullptr;
+    }
+    Py_XDECREF(ret);
+    Py_DECREF(reinterpret_cast<PyObject*>(r));  // drop the registry ref
+    Py_RETURN_NONE;
+  }
+  Py_INCREF(msg);
+  m->msgs->push_back({tag, msg});
+  Py_RETURN_NONE;
+}
+
+static PyObject* Mailbox_recv(PyObject* self, PyObject* tag_o) {
+  MailboxObject* m = reinterpret_cast<MailboxObject*>(self);
+  uint64_t tag = PyLong_AsUnsignedLongLong(tag_o);
+  if (tag == static_cast<uint64_t>(-1) && PyErr_Occurred()) return nullptr;
+  MailRecvObject* r =
+      reinterpret_cast<MailRecvObject*>(MailRecvType.tp_alloc(&MailRecvType, 0));
+  if (!r) return nullptr;
+  Py_INCREF(self);
+  r->mb = m;
+  r->tag = tag;
+  r->value = nullptr;
+  r->waker = nullptr;
+  r->done = 0;
+  r->registered = 0;
+  for (size_t i = 0; i < m->msgs->size(); ++i) {
+    if ((*m->msgs)[i].first != tag) continue;
+    r->value = (*m->msgs)[i].second;  // transfer the buffered ref
+    m->msgs->erase(m->msgs->begin() + static_cast<long>(i));
+    return reinterpret_cast<PyObject*>(r);
+  }
+  Py_INCREF(reinterpret_cast<PyObject*>(r));  // registry ref
+  m->reg->push_back({tag, r});
+  r->registered = 1;
+  return reinterpret_cast<PyObject*>(r);
+}
+
+static PyMethodDef Mailbox_methods[] = {
+    {"deliver", Mailbox_deliver, METH_O,
+     "deliver(msg): wake the first receiver registered for msg.tag, "
+     "else buffer"},
+    {"recv", Mailbox_recv, METH_O,
+     "recv(tag) -> MailRecv pollable (buffered message or eager "
+     "registration)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static int MailRecv_traverse(PyObject* self, visitproc visit, void* arg) {
+  MailRecvObject* r = reinterpret_cast<MailRecvObject*>(self);
+  Py_VISIT(reinterpret_cast<PyObject*>(r->mb));
+  Py_VISIT(r->value);
+  Py_VISIT(r->waker);
+  return 0;
+}
+
+static void mailrecv_deregister(MailRecvObject* r) {
+  if (!r->registered || !r->mb || !r->mb->reg) return;
+  r->registered = 0;
+  auto* reg = r->mb->reg;
+  for (size_t i = 0; i < reg->size(); ++i) {
+    if ((*reg)[i].second != r) continue;
+    reg->erase(reg->begin() + static_cast<long>(i));
+    Py_DECREF(reinterpret_cast<PyObject*>(r));
+    return;
+  }
+}
+
+static int MailRecv_clear_gc(PyObject* self) {
+  MailRecvObject* r = reinterpret_cast<MailRecvObject*>(self);
+  Py_CLEAR(r->value);
+  Py_CLEAR(r->waker);
+  PyObject* mb = reinterpret_cast<PyObject*>(r->mb);
+  r->mb = nullptr;
+  Py_XDECREF(mb);
+  return 0;
+}
+
+static void MailRecv_dealloc(PyObject* self) {
+  MailRecvObject* r = reinterpret_cast<MailRecvObject*>(self);
+  PyObject_GC_UnTrack(self);
+  mailrecv_deregister(r);
+  MailRecv_clear_gc(self);
+  Py_TYPE(self)->tp_free(self);
+}
+
+static PyObject* MailRecv_poll(PyObject* self, PyObject* waker) {
+  MailRecvObject* r = reinterpret_cast<MailRecvObject*>(self);
+  if (r->value) {
+    r->done = 1;
+    if (ensure_future_imports() < 0) return nullptr;
+    PyObject* ready = PyObject_CallOneArg(g_ready_cls, r->value);
+    Py_CLEAR(r->value);
+    return ready;
+  }
+  Py_INCREF(waker);
+  Py_XSETREF(r->waker, waker);
+  if (ensure_future_imports() < 0) return nullptr;
+  Py_INCREF(g_pending);
+  return g_pending;
+}
+
+static PyObject* MailRecv_drop(PyObject* self, PyObject*) {
+  MailRecvObject* r = reinterpret_cast<MailRecvObject*>(self);
+  if (!r->done) mailrecv_deregister(r);
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef MailRecv_methods[] = {
+    {"poll", MailRecv_poll, METH_O, "Pollable.poll(waker)"},
+    {"drop", MailRecv_drop, METH_NOARGS,
+     "deregister a pending receiver (cancellation safety)"},
+    {nullptr, nullptr, 0, nullptr},
 };
 
 // ---------------------------------------------------------------------------
@@ -1250,9 +1490,27 @@ PyMODINIT_FUNC PyInit_hostcore(void) {
   SleepGateType.tp_doc = "sleep pollable with a native poll";
   if (PyType_Ready(&SleepGateType) < 0) return nullptr;
 
+  MailboxType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  MailboxType.tp_new = Mailbox_new;
+  MailboxType.tp_dealloc = Mailbox_dealloc;
+  MailboxType.tp_traverse = Mailbox_traverse;
+  MailboxType.tp_clear = Mailbox_clear_gc;
+  MailboxType.tp_methods = Mailbox_methods;
+  MailboxType.tp_doc = "tag-matched mailbox (reference: endpoint.rs:298-352)";
+  if (PyType_Ready(&MailboxType) < 0) return nullptr;
+
+  MailRecvType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  MailRecvType.tp_dealloc = MailRecv_dealloc;
+  MailRecvType.tp_traverse = MailRecv_traverse;
+  MailRecvType.tp_clear = MailRecv_clear_gc;
+  MailRecvType.tp_methods = MailRecv_methods;
+  MailRecvType.tp_doc = "pending tag receive (Pollable)";
+  if (PyType_Ready(&MailRecvType) < 0) return nullptr;
+
 #define INTERN(var, name)                     \
   var = PyUnicode_InternFromString(name);     \
   if (!var) return nullptr;
+  INTERN(s_tag, "tag")
   INTERN(s_time_limit_hit, "_time_limit_hit")
   INTERN(s_waker, "waker")
   INTERN(s_pending_on, "pending_on")
@@ -1298,6 +1556,13 @@ PyMODINIT_FUNC PyInit_hostcore(void) {
   if (PyModule_AddObject(m, "TaskWaker",
                          reinterpret_cast<PyObject*>(&TaskWakerType)) < 0) {
     Py_DECREF(&TaskWakerType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  Py_INCREF(&MailboxType);
+  if (PyModule_AddObject(m, "Mailbox",
+                         reinterpret_cast<PyObject*>(&MailboxType)) < 0) {
+    Py_DECREF(&MailboxType);
     Py_DECREF(m);
     return nullptr;
   }

@@ -25,6 +25,8 @@ from .endpoint import (
     PayloadReceiver,
     PayloadSender,
 )
+from .. import _context
+from .. import time as sim_time
 from .ipvs import IpVirtualServer, Scheduler, ServiceAddr
 from .network import (
     Addr,
@@ -169,8 +171,6 @@ class NetSim(Simulator):
     async def rand_delay(self) -> None:
         """Random processing delay before each send: 0-5 us, buggified to
         1-5 s with 10% probability (reference: mod.rs:287-296)."""
-        from .. import time as sim_time
-
         if self.rng.buggify_with_prob(0.1):
             delay = self.rng.gen_range(1 * SEC, 5 * SEC)
         else:
@@ -203,8 +203,43 @@ class NetSim(Simulator):
 
         `kind` marks RPC traffic so request/response drop hooks apply to
         the right direction only (reference applies hooks by payload type,
-        mod.rs:308-312)."""
-        await self.rand_delay()
+        mod.rs:308-312).
+
+        The 0-5 us processing delay runs as a TIMER callback, not a
+        coroutine suspension: the wire outcome (hooks, clog/loss test,
+        latency draw) still happens at t+delay like the reference, but
+        the sender resumes immediately — two task polls cheaper per
+        datagram on the executor's hot loop. The buggified 1-5 s delay
+        keeps the blocking await: there the backpressure IS the injected
+        chaos (reference: mod.rs:287-296)."""
+        # DNS errors surface to the caller (reference: lookup failure is
+        # the send's error); hooks still observe the ORIGINAL destination
+        # the sender used, and clog/loss/latency stay at the wire moment
+        resolved = self.resolve_name(dst)
+        if self.rng.buggify_with_prob(0.1):
+            await sim_time.sleep_ns(self.rng.gen_range(1 * SEC, 5 * SEC))
+            self._send_phase2(src_node, src_addr, dst, resolved, tag, payload, kind)
+            return
+        delay = self.rng.gen_range(0, 5 * US)
+        self.time.add_timer_ns(
+            self.time.now_ns() + delay,
+            lambda: self._send_phase2_guarded(
+                src_node, src_addr, dst, resolved, tag, payload, kind
+            ),
+        )
+
+    def _send_phase2_guarded(self, *args) -> None:
+        """Timer-context wrapper: a raising drop-hook must surface as a
+        simulation panic (the standard loud-failure path), not unwind
+        the executor's timer machinery."""
+        try:
+            self._send_phase2(*args)
+        except BaseException as exc:  # noqa: BLE001 - routed, not swallowed
+            _context.current().executor.panic = exc
+
+    def _send_phase2(self, src_node, src_addr, dst, resolved, tag, payload, kind) -> None:
+        """On-the-wire moment: drop hooks (seeing the sender's `dst`),
+        IPVS rewrite, clog/loss/latency."""
         if kind == "rpc_req":
             hooks = self._hooks_req
         elif kind == "rpc_rsp":
@@ -214,13 +249,12 @@ class NetSim(Simulator):
         for hook in hooks:
             if not hook(src_addr, dst, tag, payload):
                 return  # dropped by hook
-        dst = self.resolve_name(dst)
-        rewritten = self.ipvs.rewrite("udp", dst)
+        rewritten = self.ipvs.rewrite("udp", resolved)
         if rewritten is not None:
-            dst = rewritten
-        msg = Message(tag, payload, (self._src_ip(src_node, dst), src_addr[1]))
+            resolved = rewritten
+        msg = Message(tag, payload, (self._src_ip(src_node, resolved), src_addr[1]))
         self.network.try_send(
-            src_node, src_addr, dst, lambda sock: sock.deliver(msg), payload
+            src_node, src_addr, resolved, lambda sock: sock.deliver(msg), payload
         )
 
     def _src_ip(self, src_node: int, dst: Addr) -> str:

@@ -16,6 +16,7 @@ from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from .. import _context
+from .. import time as sim_time
 from ..errors import SimError
 from ..future import PENDING, OneShotCell, Pollable, Ready, await_
 from .network import (
@@ -64,6 +65,22 @@ class Mailbox:
 
     def deregister(self, cell: OneShotCell) -> None:
         self.registered = [(t, c) for (t, c) in self.registered if c is not cell]
+
+    def recv(self, tag: int) -> "_MailboxRecv":
+        """Pollable for the next `tag` message (same surface as the
+        native hostcore.Mailbox.recv)."""
+        return _MailboxRecv(self, tag)
+
+
+def _new_mailbox():
+    """Native tag-matched mailbox when the toolchain built hostcore
+    (one C object replaces the recv_cell/OneShotCell/_MailboxRecv stack
+    on the RPC hot path); Python twin otherwise — same deliver/recv
+    semantics, asserted by tests/test_native.py."""
+    from .. import _native
+
+    mod = _native.get_mod()
+    return mod.Mailbox() if mod is not None else Mailbox()
 
 
 class _MailboxRecv(Pollable):
@@ -172,8 +189,6 @@ class PayloadReceiver:
     async def recv(self) -> Optional[Any]:
         """Next payload, or None on EOF. Backs off while the link is
         partitioned; applies per-message latency (reference :337-414)."""
-        from .. import time as sim_time
-
         payload = await await_(_PopFuture(self._chan))
         if payload is None:
             return None
@@ -248,7 +263,7 @@ class Endpoint:
         self.node_id = node_id
         self.local_addr = local_addr
         self.peer: Optional[Addr] = None
-        self._mailbox = Mailbox()
+        self._mailbox = _new_mailbox()
         self._accept_queue: Deque[IncomingConn] = deque()
         self._accept_wakers: List[Callable[[], None]] = []
         self._closed = False
@@ -324,7 +339,7 @@ class Endpoint:
         """Reference: endpoint.rs:135-147."""
         if self._closed:
             raise ConnectionReset("endpoint closed")
-        msg: Message = await await_(_MailboxRecv(self._mailbox, tag))
+        msg: Message = await await_(self._mailbox.recv(tag))
         return msg.payload, msg.from_addr
 
     # -- connection API -----------------------------------------------------

@@ -19,7 +19,10 @@ from __future__ import annotations
 
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple, Type
 
+from .. import rand
+from .. import time as sim_time
 from ..rand.philox import splitmix64
+from ..task import spawn
 from ..task.join import JoinHandle
 from .endpoint import Endpoint
 from .network import Addr
@@ -61,9 +64,6 @@ async def call(ep: Endpoint, dst: Any, req: Request, timeout: Optional[float] = 
 async def call_with_data(
     ep: Endpoint, dst: Any, req: Request, data: bytes, timeout: Optional[float] = None
 ) -> Tuple[Any, bytes]:
-    from .. import rand
-    from .. import time as sim_time
-
     rsp_tag = rand.thread_rng().next_u64()
 
     async def round_trip() -> Tuple[Any, bytes]:
@@ -83,8 +83,6 @@ def add_rpc_handler(ep: Endpoint, req_type: Type[Request], handler: Handler) -> 
     (reference: rpc.rs:143-167)."""
 
     async def loop_() -> None:
-        from ..task import spawn
-
         while True:
             payload, from_addr = await ep.recv_from_raw(req_type.type_id())
             rsp_tag, req, data = payload
@@ -98,8 +96,6 @@ def add_rpc_handler(ep: Endpoint, req_type: Type[Request], handler: Handler) -> 
                 await ep.send_to_raw(from_addr, rsp_tag, (rsp, bytes(rsp_data)), kind="rpc_rsp")
 
             spawn(handle_one())
-
-    from ..task import spawn
 
     return spawn(loop_())
 

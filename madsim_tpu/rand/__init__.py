@@ -110,6 +110,11 @@ class GlobalRng:
         return v
 
     def next_u64(self) -> int:
+        # native fast path: one C call for both words; draw observation
+        # (log/check hashing) happens inside the core either way
+        core = self._core
+        if core is not None:
+            return core.next_u64()
         lo = self.next_u32()
         hi = self.next_u32()
         return (hi << 32) | lo
@@ -203,6 +208,9 @@ class GlobalRng:
 
     def random(self) -> float:
         """Uniform float64 in [0, 1) with 53 bits, identical across engines."""
+        core = self._core
+        if core is not None:
+            return core.random()  # same (u64 >> 11) * 2^-53, one C call
         return (self.next_u64() >> 11) * (2.0**-53)
 
     def gen_range(self, low: int, high: int) -> int:
@@ -214,6 +222,18 @@ class GlobalRng:
         if high <= low:
             raise ValueError(f"empty range [{low}, {high})")
         span = high - low
+        core = self._core
+        # fast path only within int64 bounds AND span: the C parser is
+        # int64 and signed high-low must not overflow — out-of-range
+        # bounds take the bignum path (identical draw sequence: it pulls
+        # next_u64 from the same core stream)
+        if (
+            core is not None
+            and -0x8000000000000000 <= low
+            and high <= 0x7FFFFFFFFFFFFFFF
+            and span <= 0x7FFFFFFFFFFFFFFF
+        ):
+            return core.gen_range(low, high)  # same low + u64 % span
         return low + self.next_u64() % span
 
     def gen_bool(self, p: float) -> bool:
