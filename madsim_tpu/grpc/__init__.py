@@ -112,11 +112,15 @@ class Status(SimError):
 class Request:
     """Request wrapper (reference: tonic::Request). `metadata` travels
     with the call (tonic: HTTP/2 headers) — populate it client-side and
-    read it in handlers via `request.metadata`."""
+    read it in handlers via `request.metadata`. Keys are lowercased like
+    gRPC wire metadata, so sim-tested header lookups behave identically
+    against a genuine server in real mode."""
 
     def __init__(self, message: Any, metadata: Optional[Dict[str, str]] = None):
         self.message = message
-        self.metadata: Dict[str, str] = dict(metadata or {})
+        self.metadata: Dict[str, str] = {
+            k.lower(): v for k, v in (metadata or {}).items()
+        }
 
     def into_inner(self) -> Any:
         return self.message
@@ -125,11 +129,14 @@ class Request:
 class Response:
     """Response wrapper (reference: tonic::Response). Handler-set
     `metadata` rides back to the caller (tonic: response headers) and is
-    visible when the client passed a `Request` wrapper in."""
+    visible when the client passed a `Request` wrapper in. Keys are
+    lowercased like gRPC wire metadata (see Request)."""
 
     def __init__(self, message: Any, metadata: Optional[Dict[str, str]] = None):
         self.message = message
-        self.metadata: Dict[str, str] = dict(metadata or {})
+        self.metadata: Dict[str, str] = {
+            k.lower(): v for k, v in (metadata or {}).items()
+        }
 
     def into_inner(self) -> Any:
         return self.message
@@ -214,14 +221,47 @@ def service(service_name: str):
 
 class Server:
     """Reference: madsim-tonic transport::Server builder (the ~20 HTTP/2
-    tuning knobs are accepted and ignored, like the reference)."""
+    tuning knobs are accepted and ignored, like the reference).
+
+    Dual-build: under MADSIM_TPU_MODE=real the builder returns the
+    grpc.aio-backed RealRouter, so `Server.builder().add_service(...)
+    .serve(addr)` written against generated stubs hosts a genuine gRPC
+    server in production — the server-side half of the reference's
+    `#[cfg(madsim)]` re-export (madsim-tonic/src/lib.rs:1-8)."""
 
     @staticmethod
-    def builder() -> "Router":
-        return Router()
+    def builder():
+        from ..dual import IS_SIM
+
+        if IS_SIM:
+            return Router()
+        from .real import RealRouter
+
+        return RealRouter()
 
 
-class Router:
+class ConfigKnobs:
+    """No-op HTTP/2 config surface (parity with the reference's builder)
+    — shared by the sim Router and the real-mode RealRouter so the knob
+    surface cannot drift between modes."""
+
+    def timeout(self, *_a, **_k):
+        return self
+
+    def concurrency_limit_per_connection(self, *_a, **_k):
+        return self
+
+    def tcp_nodelay(self, *_a, **_k):
+        return self
+
+    def http2_keepalive_interval(self, *_a, **_k):
+        return self
+
+    def max_frame_size(self, *_a, **_k):
+        return self
+
+
+class Router(ConfigKnobs):
     """Reference: transport/server.rs `Router`."""
 
     def __init__(self) -> None:
@@ -233,22 +273,6 @@ class Router:
         tower layer): runs on every incoming Request before dispatch;
         raise `Status` to reject (e.g. UNAUTHENTICATED)."""
         self._interceptor = fn
-        return self
-
-    # no-op HTTP/2 config surface (parity with the reference's builder)
-    def timeout(self, *_a, **_k) -> "Router":
-        return self
-
-    def concurrency_limit_per_connection(self, *_a, **_k) -> "Router":
-        return self
-
-    def tcp_nodelay(self, *_a, **_k) -> "Router":
-        return self
-
-    def http2_keepalive_interval(self, *_a, **_k) -> "Router":
-        return self
-
-    def max_frame_size(self, *_a, **_k) -> "Router":
         return self
 
     def add_service(self, svc: Any) -> "Router":

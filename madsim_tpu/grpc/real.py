@@ -19,6 +19,7 @@ import grpc.aio as _aio
 
 from . import (
     Code,
+    ConfigKnobs,
     Request,
     Response,
     SHAPE_CLIENT_STREAMING,
@@ -224,12 +225,13 @@ class _GeneratedServiceHandler(_grpc.GenericRpcHandler):
     shape-decorated handlers, with protobuf (de)serialization from the
     descriptor-derived `__grpc_method_types__` map."""
 
-    def __init__(self, svc):
+    def __init__(self, svc, interceptor=None):
         cls = type(svc)
         self._svc = svc
         self._name = cls.__grpc_service_name__
         self._methods = cls.__grpc_methods__
         self._type_map = getattr(cls, "__grpc_method_types__", {})
+        self._interceptor = interceptor
 
     def service(self, handler_call_details):
         path = handler_call_details.method
@@ -244,9 +246,26 @@ class _GeneratedServiceHandler(_grpc.GenericRpcHandler):
         handler = getattr(self._svc, py_name)
         deser = req_cls.FromString if req_cls is not None else None
 
+        get_interceptor = self._interceptor
+
         def _req(msg, context) -> Request:
             md = {k: v for k, v in (context.invocation_metadata() or ())}
-            return Request(msg, md)
+            request = Request(msg, md)
+            interceptor = get_interceptor() if get_interceptor is not None else None
+            if interceptor is not None:
+                request = interceptor(request)  # may raise Status
+            return request
+
+        def _guard_stream(context) -> None:
+            """Interceptor check for the streaming-request shapes — the
+            sim Router runs the interceptor on EVERY shape before
+            dispatch (message=None for streams), and an auth guard that
+            only fires for unary in real mode would be a silent
+            production bypass."""
+            interceptor = get_interceptor() if get_interceptor is not None else None
+            if interceptor is not None:
+                md = {k: v for k, v in (context.invocation_metadata() or ())}
+                interceptor(Request(None, md))  # may raise Status
 
         def _unwrap(rsp):
             return rsp.into_inner() if isinstance(rsp, Response) else rsp
@@ -266,6 +285,7 @@ class _GeneratedServiceHandler(_grpc.GenericRpcHandler):
 
             async def cs(request_iterator, context):
                 try:
+                    _guard_stream(context)
                     return _unwrap(await handler(_RequestStream(request_iterator)))
                 except Status as st:
                     await context.abort(*_abort_args(st))
@@ -288,6 +308,7 @@ class _GeneratedServiceHandler(_grpc.GenericRpcHandler):
 
         async def bidi(request_iterator, context):
             try:
+                _guard_stream(context)
                 async for item in handler(_RequestStream(request_iterator)):
                     yield _unwrap(item)
             except Status as st:
@@ -298,18 +319,29 @@ class _GeneratedServiceHandler(_grpc.GenericRpcHandler):
         )
 
 
-class RealRouter:
+class RealRouter(ConfigKnobs):
     """Real-mode `Server.builder()` twin: `.add_service(...).serve(addr)`
-    hosts generated services on a genuine grpc.aio server."""
+    hosts generated services on a genuine grpc.aio server. The sim
+    Router's no-op HTTP/2 knobs and serve/shutdown surface apply here
+    too, so dual-mode app code runs unchanged."""
 
     def __init__(self) -> None:
         self._handlers = []
         self._server = None
+        self._interceptor = None
+
+    def intercept(self, fn) -> "RealRouter":
+        """Server interceptor (sim Router.intercept twin): runs on every
+        incoming Request before dispatch; raise `Status` to reject."""
+        self._interceptor = fn
+        return self
 
     def add_service(self, svc) -> "RealRouter":
         if not hasattr(type(svc), "__grpc_service_name__"):
             raise Status.internal(f"{type(svc).__name__} is not a generated/decorated service")
-        self._handlers.append(_GeneratedServiceHandler(svc))
+        # late-bound: intercept() may be called after add_service, and it
+        # must cover every service (sim Router semantics)
+        self._handlers.append(_GeneratedServiceHandler(svc, lambda: self._interceptor))
         return self
 
     async def start(self, addr: str) -> int:
@@ -323,6 +355,16 @@ class RealRouter:
     async def serve(self, addr: str) -> None:
         await self.start(addr)
         await self._server.wait_for_termination()
+
+    async def serve_with_shutdown(self, addr: str, shutdown) -> None:
+        """Sim Router surface: serve until `shutdown` (an awaitable or
+        None) completes, then stop gracefully."""
+        if shutdown is None:
+            await self.serve(addr)
+            return
+        await self.start(addr)
+        await shutdown
+        await self.stop()
 
     async def stop(self, grace: Optional[float] = None) -> None:
         if self._server is not None:
