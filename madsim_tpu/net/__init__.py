@@ -74,6 +74,7 @@ Hook = Callable[[Addr, Addr, int, Any], bool]
 # Imported at module bottom to finish wiring (rpc attaches Endpoint.call etc.).
 from .tcp import TcpListener, TcpStream  # noqa: E402
 from .udp import UdpSocket  # noqa: E402
+from .unix import UnixDatagram, UnixListener, UnixStream  # noqa: E402
 from .rpc import Request, hash_str, rpc, service  # noqa: E402
 
 
@@ -89,6 +90,11 @@ class NetSim(Simulator):
         self._channels: Dict[int, List[PayloadChannel]] = {}
         self._hooks_req: List[Hook] = []
         self._hooks_rsp: List[Hook] = []
+        # Unix-domain namespace: per-node path -> listener/datagram
+        # (node-local IPC; kill wipes the namespace like a tmpfs socket
+        # dir) + open stream pipes for EOF-on-kill
+        self.unix_paths: Dict[int, Dict[str, Any]] = {}
+        self.unix_pipes: Dict[int, List[Any]] = {}
 
     # -- Simulator lifecycle ------------------------------------------------
 
@@ -106,6 +112,9 @@ class NetSim(Simulator):
             ep._on_reset()
         for chan in self._channels.pop(node_id, []):
             chan.do_reset()
+        self.unix_paths.pop(node_id, None)
+        for pipe in self.unix_pipes.pop(node_id, []):
+            pipe.close()
 
     def register_endpoint(self, node_id: int, ep: Endpoint) -> None:
         self._endpoints.setdefault(node_id, []).append(ep)
