@@ -46,6 +46,9 @@ __all__ = [
     "TcpListener",
     "TcpStream",
     "UdpSocket",
+    "UnixDatagram",
+    "UnixListener",
+    "UnixStream",
     "Request",
     "rpc",
     "service",
@@ -112,7 +115,11 @@ class NetSim(Simulator):
             ep._on_reset()
         for chan in self._channels.pop(node_id, []):
             chan.do_reset()
-        self.unix_paths.pop(node_id, None)
+        # close (not just discard) the namespace entries: a waiter
+        # parked in accept()/recv_from() from another context must see
+        # reset, matching the EOF the stream pipes get below
+        for sock in self.unix_paths.pop(node_id, {}).values():
+            sock.close()
         for pipe in self.unix_pipes.pop(node_id, []):
             pipe.close()
 

@@ -391,3 +391,29 @@ def test_proto_emit_module(tmp_path):
     assert "helloworld.Greeter" in mod.services
     assert mod.GreeterServer.__grpc_methods__["SayHello"] == ("say_hello", "unary")
     assert mod.GreeterServer.__grpc_methods__["BidiHello"] == ("bidi_hello", "streaming")
+
+
+def test_client_drops_response_stream():
+    """Reference: tonic-example/tests/test.rs client_drops_response_stream
+    (:203-231) — a client that abandons a server stream mid-flight must
+    not wedge or crash the server; later calls keep working."""
+
+    async def main():
+        handle = Handle.current()
+        await _start_server(handle)
+        client = handle.create_node().ip("10.5.0.2").build()
+
+        async def go():
+            ch = await grpc.connect("http://10.5.0.1:50051")
+            stream = await ch.server_streaming("/helloworld.Greeter/LotsOfReplies", "dropme")
+            first = await stream.message()  # consume one, then abandon
+            del stream
+            await sim_time.sleep(2.0)  # server keeps streaming into the void
+            ok = await ch.unary("/helloworld.Greeter/SayHello", "after")
+            return first, ok
+
+        return await client.spawn(go())
+
+    first, ok = run(main)
+    assert first == "dropme #0"
+    assert ok == "Hello after!"

@@ -113,6 +113,48 @@ def test_kill_wipes_namespace_and_eofs_streams():
     assert run(main)
 
 
+def test_kill_resets_parked_cross_context_waiters():
+    """A waiter parked in accept()/recv_from() (possibly from another
+    task context holding the socket) must see reset when the binding
+    node dies — not hang forever."""
+
+    async def main():
+        handle = Handle.current()
+        node = handle.create_node().build()
+        state = {}
+
+        async def app():
+            state["listener"] = await UnixListener.bind("/run/k.sock")
+            state["dgram"] = await UnixDatagram.bind("/run/kd.sock")
+            await sim_time.sleep(10)
+
+        node.spawn(app())
+        await sim_time.sleep(0.05)
+
+        outcomes = []
+
+        async def wait_accept():
+            try:
+                await state["listener"].accept()
+            except ConnectionReset:
+                outcomes.append("accept-reset")
+
+        async def wait_recv():
+            try:
+                await state["dgram"].recv_from()
+            except ConnectionReset:
+                outcomes.append("recv-reset")
+
+        spawn(wait_accept())
+        spawn(wait_recv())
+        await sim_time.sleep(0.05)
+        handle.kill(node.id)
+        await sim_time.sleep(0.05)
+        return sorted(outcomes)
+
+    assert run(main) == ["accept-reset", "recv-reset"]
+
+
 def test_datagram_send_recv_and_connect():
     async def main():
         handle = Handle.current()
