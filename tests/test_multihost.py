@@ -4,7 +4,9 @@ SPMD code path a real multi-host TPU job takes over DCN.
 
 The workers run in subprocesses because each jax process owns its
 runtime; the parent asserts both processes computed identical replicated
-results over the 8 global devices.
+results over the 8 global devices. One worker script serves all tests,
+gated by MADSIM_TPU_TEST_SECTION so each test pays only for its own
+workload and a regression in one block cannot fail the others.
 """
 
 import os
@@ -12,8 +14,6 @@ import socket
 import subprocess
 import sys
 import textwrap
-
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -26,23 +26,44 @@ WORKER = textwrap.dedent(
     from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
     from madsim_tpu.models.echo import EchoMachine
 
-    eng = Engine(
-        EchoMachine(rounds=4),
-        EngineConfig(horizon_us=3_000_000, queue_capacity=16,
-                     faults=FaultPlan(n_faults=0)),
-    )
-    out = multihost.run_batch_global(eng, 32, seed_start=10, max_steps=400)
-    print("RESULT", out["processes"], out["global_devices"],
-          out["completed"], out["failed"], flush=True)
+    section = os.environ["MADSIM_TPU_TEST_SECTION"]
 
-    # streaming path over the same global mesh: every process runs the
-    # identical SPMD loop; counters/rings come back replicated
-    stream = eng.run_stream(
-        64, batch=16, segment_steps=64, seed_start=100, max_steps=400,
-        mesh=multihost.global_mesh(),
-    )
-    print("STREAM", stream["completed"], len(stream["failing"]),
-          stream["seeds_consumed"], flush=True)
+    if section == "batch":
+        eng = Engine(
+            EchoMachine(rounds=4),
+            EngineConfig(horizon_us=3_000_000, queue_capacity=16,
+                         faults=FaultPlan(n_faults=0)),
+        )
+        out = multihost.run_batch_global(eng, 32, seed_start=10, max_steps=400)
+        print("RESULT", out["processes"], out["global_devices"],
+              out["completed"], out["failed"], flush=True)
+    elif section == "stream":
+        eng = Engine(
+            EchoMachine(rounds=4),
+            EngineConfig(horizon_us=3_000_000, queue_capacity=16,
+                         faults=FaultPlan(n_faults=0)),
+        )
+        # streaming over the global mesh: every process runs the identical
+        # SPMD loop; counters/rings come back replicated
+        stream = eng.run_stream(
+            64, batch=16, segment_steps=64, seed_start=100, max_steps=400,
+            mesh=multihost.global_mesh(),
+        )
+        print("STREAM", stream["completed"], len(stream["failing"]),
+              stream["seeds_consumed"], flush=True)
+    elif section == "mvcc":
+        # a service-class machine (round-3 MVCC etcd) with faults: the
+        # distributed path must not be an echo-only artifact
+        from madsim_tpu.models.etcd_mvcc import EtcdMvccMachine
+        eng = Engine(
+            EtcdMvccMachine(4, target_ops=3),
+            EngineConfig(horizon_us=4_000_000, queue_capacity=48,
+                         faults=FaultPlan(n_faults=1, t_max_us=1_000_000)),
+        )
+        out = multihost.run_batch_global(eng, 16, seed_start=0, max_steps=1500)
+        print("MVCC", out["completed"], out["failed"], flush=True)
+    else:
+        raise SystemExit(f"unknown section {{section!r}}")
     """
 ).format(repo=REPO)
 
@@ -53,7 +74,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_global_batch():
+def _run_workers(section: str, tag: str):
+    """Spawn the 2-process distributed job for `section`; return each
+    worker's parsed `tag` line. Asserts both workers exit 0."""
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -65,44 +88,7 @@ def test_two_process_global_batch():
             MADSIM_TPU_COORDINATOR=f"127.0.0.1:{port}",
             MADSIM_TPU_NUM_PROCS="2",
             MADSIM_TPU_PROC_ID=str(pid),
-        )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-c", WORKER],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-            )
-        )
-    results = []
-    for p in procs:
-        out, err = p.communicate(timeout=240)
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-        line = [ln for ln in out.splitlines() if ln.startswith("RESULT")]
-        assert line, f"no RESULT line:\n{out}\n{err}"
-        results.append(line[0].split())
-
-    # both processes see the job (2 procs x 4 devices) and agree exactly
-    assert results[0] == results[1]
-    _tag, nprocs, ndev, completed, failed = results[0]
-    assert (nprocs, ndev) == ("2", "8")
-    assert int(completed) == 32 and int(failed) == 0
-
-
-def test_two_process_streaming():
-    # covered by the same workers (they print a STREAM line after RESULT)
-    port = _free_port()
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.update(
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=4",
-            MADSIM_TPU_COORDINATOR=f"127.0.0.1:{port}",
-            MADSIM_TPU_NUM_PROCS="2",
-            MADSIM_TPU_PROC_ID=str(pid),
+            MADSIM_TPU_TEST_SECTION=section,
         )
         procs.append(
             subprocess.Popen(
@@ -114,10 +100,31 @@ def test_two_process_streaming():
     for p in procs:
         out, err = p.communicate(timeout=240)
         assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-        stream = [ln for ln in out.splitlines() if ln.startswith("STREAM")]
-        assert stream, f"no STREAM line:\n{out}\n{err}"
-        lines.append(stream[0].split())
+        match = [ln for ln in out.splitlines() if ln.startswith(tag)]
+        assert match, f"no {tag} line:\n{out}\n{err}"
+        lines.append(match[0].split())
+    return lines
+
+
+def test_two_process_global_batch():
+    results = _run_workers("batch", "RESULT")
+    # both processes see the job (2 procs x 4 devices) and agree exactly
+    assert results[0] == results[1]
+    _tag, nprocs, ndev, completed, failed = results[0]
+    assert (nprocs, ndev) == ("2", "8")
+    assert int(completed) == 32 and int(failed) == 0
+
+
+def test_two_process_streaming():
+    lines = _run_workers("stream", "STREAM")
     # identical replicated results on both processes; all 64 seeds done
     assert lines[0] == lines[1]
     _tag, completed, n_fail, consumed = lines[0]
     assert int(completed) >= 64 and int(n_fail) == 0 and int(consumed) >= 64
+
+
+def test_two_process_service_machine():
+    lines = _run_workers("mvcc", "MVCC")
+    assert lines[0] == lines[1]
+    _tag, completed, failed = lines[0]
+    assert int(completed) == 16 and int(failed) == 0
