@@ -16,9 +16,11 @@ stest:
 		--ignore=tests/test_pallas.py --ignore=tests/test_soak.py \
 		--ignore=tests/test_native.py
 
-# real-socket mode
+# real-socket mode + genuine-wire passthrough suites
 rtest:
-	$(PY) -m pytest tests/test_real_mode.py -x -q
+	$(PY) -m pytest tests/test_real_mode.py tests/test_grpc_real.py \
+		tests/test_etcd_real.py tests/test_s3_real.py \
+		tests/test_kafka_real.py -x -q
 
 # determinism self-checks (host harness + engine)
 check:
