@@ -409,6 +409,28 @@ def cmd_serve(args) -> int:
             print(f"s3 serving on {host}:{port} (genuine S3 REST wire)", flush=True)
             await gw.wait()
             return
+        if getattr(args, "wire", False):
+            if args.service != "kafka":
+                sys.exit("--wire is only available for --service kafka")
+            from .services.kafka.wire_gateway import KafkaWireGateway
+
+            host = args.addr.rsplit(":", 1)[0]
+            # Metadata/FindCoordinator responses must name an address
+            # clients can CONNECT to — a 0.0.0.0 bind is not one (real
+            # brokers split listeners from advertised.listeners too)
+            advertise = getattr(args, "advertise", None) or (
+                host if host and host != "0.0.0.0" else "127.0.0.1"
+            )
+            gw = KafkaWireGateway(advertised_host=advertise)
+            port = await gw.start(args.addr)
+            gw.advertised_port = port
+            print(
+                f"kafka serving on {host or '127.0.0.1'}:{port} "
+                f"(genuine Kafka wire, advertising {advertise}:{port})",
+                flush=True,
+            )
+            await gw.wait()
+            return
         if args.service == "etcd":
             from .services.etcd import SimServer
 
@@ -544,6 +566,20 @@ def main(argv=None) -> int:
         action="store_true",
         help="s3 only: serve the genuine S3 REST wire protocol "
         "instead of the pickle sim protocol",
+    )
+    p.add_argument(
+        "--wire",
+        action="store_true",
+        help="kafka only: serve the genuine Kafka wire protocol "
+        "(ApiVersions/Metadata/Produce/Fetch/group APIs) instead of the "
+        "pickle sim protocol",
+    )
+    p.add_argument(
+        "--advertise",
+        default=None,
+        help="kafka --wire only: hostname to advertise in Metadata/"
+        "FindCoordinator responses (defaults to the bind host, or "
+        "127.0.0.1 when binding 0.0.0.0)",
     )
     p.set_defaults(fn=cmd_serve)
 

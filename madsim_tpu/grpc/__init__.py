@@ -34,6 +34,7 @@ __all__ = [
     "Code",
     "Request",
     "Response",
+    "Metadata",
     "Streaming",
     "service",
     "unary",
@@ -86,7 +87,7 @@ class Status(SimError):
         super().__init__(f"status {code}: {message}")
         self.code = code
         self.message = message
-        self.metadata: Dict[str, str] = dict(metadata or {})
+        self.metadata: "Metadata" = Metadata(metadata)  # trailers; case-insensitive
 
     @staticmethod
     def unauthenticated(msg: str) -> "Status":
@@ -109,18 +110,58 @@ class Status(SimError):
         return Status(Code.INTERNAL, msg)
 
 
+class Metadata(dict):
+    """Case-insensitive metadata map (reference: tonic::metadata::MetadataMap).
+
+    Keys are STORED lowercased — matching gRPC wire metadata, so
+    sim-tested code behaves identically against a genuine server in real
+    mode — but every lookup/mutation is case-insensitive, so an app that
+    sets "X-Trace-Id" and reads "X-Trace-Id" works in both modes rather
+    than silently missing."""
+
+    def __init__(self, items: Optional[Dict[str, str]] = None):
+        super().__init__()
+        for k, v in (items or {}).items():
+            self[k] = v
+
+    def __setitem__(self, key: str, value: str) -> None:
+        super().__setitem__(key.lower(), value)
+
+    def __getitem__(self, key: str) -> str:
+        return super().__getitem__(key.lower())
+
+    def __contains__(self, key) -> bool:
+        return super().__contains__(key.lower() if isinstance(key, str) else key)
+
+    def get(self, key: str, default=None):
+        return super().get(key.lower(), default)
+
+    def pop(self, key: str, *default):
+        return super().pop(key.lower(), *default)
+
+    def setdefault(self, key: str, default=None):
+        return super().setdefault(key.lower(), default)
+
+    def __delitem__(self, key: str) -> None:
+        super().__delitem__(key.lower())
+
+    def copy(self) -> "Metadata":
+        return Metadata(self)
+
+    def update(self, other=None, **kw):  # type: ignore[override]
+        for k, v in dict(other or {}, **kw).items():
+            self[k] = v
+
+
 class Request:
     """Request wrapper (reference: tonic::Request). `metadata` travels
     with the call (tonic: HTTP/2 headers) — populate it client-side and
-    read it in handlers via `request.metadata`. Keys are lowercased like
-    gRPC wire metadata, so sim-tested header lookups behave identically
-    against a genuine server in real mode."""
+    read it in handlers via `request.metadata` (case-insensitive, stored
+    lowercase like gRPC wire metadata)."""
 
     def __init__(self, message: Any, metadata: Optional[Dict[str, str]] = None):
         self.message = message
-        self.metadata: Dict[str, str] = {
-            k.lower(): v for k, v in (metadata or {}).items()
-        }
+        self.metadata: Metadata = Metadata(metadata)
 
     def into_inner(self) -> Any:
         return self.message
@@ -129,14 +170,12 @@ class Request:
 class Response:
     """Response wrapper (reference: tonic::Response). Handler-set
     `metadata` rides back to the caller (tonic: response headers) and is
-    visible when the client passed a `Request` wrapper in. Keys are
-    lowercased like gRPC wire metadata (see Request)."""
+    visible when the client passed a `Request` wrapper in. Lookups are
+    case-insensitive (see Metadata)."""
 
     def __init__(self, message: Any, metadata: Optional[Dict[str, str]] = None):
         self.message = message
-        self.metadata: Dict[str, str] = {
-            k.lower(): v for k, v in (metadata or {}).items()
-        }
+        self.metadata: Metadata = Metadata(metadata)
 
     def into_inner(self) -> Any:
         return self.message

@@ -98,6 +98,13 @@ class NetSim(Simulator):
         # dir) + open stream pipes for EOF-on-kill
         self.unix_paths: Dict[int, Dict[str, Any]] = {}
         self.unix_pipes: Dict[int, List[Any]] = {}
+        # Per-node incarnation, bumped on every kill/restart reset. Timer-
+        # scheduled datagram deliveries capture the sender's incarnation at
+        # send time and drop at the wire moment if the node died in between
+        # — matching the reference, where kill cancels the sender task
+        # mid-rand_delay (sim/net/mod.rs:287-296).
+        self._incarnation: Dict[int, int] = {}
+        self._send_seq = 0
 
     # -- Simulator lifecycle ------------------------------------------------
 
@@ -111,6 +118,7 @@ class NetSim(Simulator):
         """Node kill/restart: close sockets + break connections
         (reference: mod.rs reset_node -> network.rs:142-148)."""
         self.network.reset_node(node_id)
+        self._incarnation[node_id] = self._incarnation.get(node_id, 0) + 1
         for ep in self._endpoints.pop(node_id, []):
             ep._on_reset()
         for chan in self._channels.pop(node_id, []):
@@ -221,13 +229,16 @@ class NetSim(Simulator):
         the right direction only (reference applies hooks by payload type,
         mod.rs:308-312).
 
-        The 0-5 us processing delay runs as a TIMER callback, not a
-        coroutine suspension: the wire outcome (hooks, clog/loss test,
-        latency draw) still happens at t+delay like the reference, but
-        the sender resumes immediately — two task polls cheaper per
-        datagram on the executor's hot loop. The buggified 1-5 s delay
-        keeps the blocking await: there the backpressure IS the injected
-        chaos (reference: mod.rs:287-296)."""
+        The 0-5 us processing delay normally runs as a TIMER callback,
+        not a coroutine suspension: the wire outcome (hooks, clog/loss
+        test, latency draw) still happens at t+delay like the reference,
+        but the sender resumes immediately — two task polls cheaper per
+        datagram on the executor's hot loop. Every 16th datagram keeps
+        the reference's blocking await so a tight send loop still drives
+        virtual time forward (without it, a loop that never awaits
+        recv/sleep would starve the clock). The buggified 1-5 s delay
+        always blocks: there the backpressure IS the injected chaos
+        (reference: mod.rs:287-296)."""
         # DNS errors surface to the caller (reference: lookup failure is
         # the send's error); hooks still observe the ORIGINAL destination
         # the sender used, and clog/loss/latency stay at the wire moment
@@ -237,17 +248,37 @@ class NetSim(Simulator):
             self._send_phase2(src_node, src_addr, dst, resolved, tag, payload, kind)
             return
         delay = self.rng.gen_range(0, 5 * US)
+        self._send_seq += 1
+        if self._send_seq % 16 == 0:
+            # Periodic sender suspension: guarantees clock progress for
+            # send-only loops and exercises the reference's suspend-path
+            # semantics (kill cancels the sender here).
+            await sim_time.sleep_ns(delay)
+            self._send_phase2(src_node, src_addr, dst, resolved, tag, payload, kind)
+            return
+        incarnation = self._incarnation.get(src_node, 0)
         self.time.add_timer_ns(
             self.time.now_ns() + delay,
             lambda: self._send_phase2_guarded(
-                src_node, src_addr, dst, resolved, tag, payload, kind
+                src_node, src_addr, dst, resolved, tag, payload, kind,
+                sender=(src_node, incarnation),
             ),
         )
 
-    def _send_phase2_guarded(self, *args) -> None:
+    def _send_phase2_guarded(self, *args, sender=None) -> None:
         """Timer-context wrapper: a raising drop-hook must surface as a
         simulation panic (the standard loud-failure path), not unwind
-        the executor's timer machinery."""
+        the executor's timer machinery.
+
+        `sender=(node_id, incarnation)` drops the datagram if the sending
+        node was killed or restarted after the send was issued — the
+        reference gets this for free because kill cancels the sender task
+        inside rand_delay; here the wire moment is a detached timer, so
+        the liveness check is explicit."""
+        if sender is not None:
+            node_id, incarnation = sender
+            if self._incarnation.get(node_id, 0) != incarnation:
+                return  # sender died between send and wire moment
         try:
             self._send_phase2(*args)
         except BaseException as exc:  # noqa: BLE001 - routed, not swallowed

@@ -232,6 +232,12 @@ class RealEtcdBackend:
     def __init__(self, channel, ns):
         self._chan = channel
         self._ns = ns
+        # One long-lived LeaseKeepAlive bidi stream for ALL keepalives
+        # (etcd clients multiplex keepalives this way); opening a fresh
+        # stream per call churns grpc.aio call objects and server-side
+        # generators under frequent keepalives.
+        self._ka = None  # (request queue, RealStreaming) or None
+        self._ka_lock = None
 
     @classmethod
     async def connect(cls, endpoint: str, probe_timeout: float = 2.0) -> "RealEtcdBackend":
@@ -255,7 +261,55 @@ class RealEtcdBackend:
         return cls(RealChannelHolder(chan), ns)
 
     async def close(self) -> None:
+        if self._ka is not None:
+            self._ka[0].put_nowait(None)  # end the feeder generator
+            self._ka = None
         await self._chan.chan.close()
+
+    async def _keep_alive_once(self, lease_id: int):
+        """One keepalive round-trip on the cached bidi stream; reopens
+        the stream once if the server ended it (e.g. idle timeout)."""
+        import asyncio
+
+        ns = self._ns
+        if self._ka_lock is None:
+            self._ka_lock = asyncio.Lock()
+        async with self._ka_lock:  # pair each request with its response
+            for attempt in (0, 1):
+                if self._ka is None:
+                    q: asyncio.Queue = asyncio.Queue()
+
+                    async def feed(q=q):
+                        while True:
+                            item = await q.get()
+                            if item is None:
+                                return
+                            yield item
+
+                    stream = await self._chan.chan.streaming(
+                        "/etcdserverpb.Lease/LeaseKeepAlive", feed()
+                    )
+                    self._ka = (q, stream)
+                q, stream = self._ka
+                q.put_nowait(ns.LeaseKeepAliveRequest(ID=lease_id))
+                try:
+                    rsp = await stream.message()
+                except BaseException as exc:
+                    # the response is (or may be) in flight: the stream
+                    # cannot be reused or later keepalives would read
+                    # this call's response (request/response desync)
+                    self._ka = None
+                    q.put_nowait(None)  # end the feeder generator
+                    if not isinstance(exc, Exception):
+                        raise  # cancellation propagates
+                    rsp = None
+                if rsp is None:
+                    self._ka = None
+                    if attempt == 0:
+                        continue  # stream was stale; retry on a fresh one
+                    raise EtcdError("lease keepalive stream closed")
+                return rsp
+        raise AssertionError("unreachable")
 
     async def call(self, req: tuple):
         """The SimServer._apply dispatch, against the real wire."""
@@ -330,13 +384,7 @@ class RealEtcdBackend:
                 )
                 return {"revision": r.header.revision}
             if kind == "lease_keep_alive":
-                stream = await ch.streaming(
-                    "/etcdserverpb.Lease/LeaseKeepAlive",
-                    [ns.LeaseKeepAliveRequest(ID=req[1])],
-                )
-                rsp = await stream.message()
-                if rsp is None:
-                    raise EtcdError("lease keepalive stream closed")
+                rsp = await self._keep_alive_once(req[1])
                 if rsp.TTL <= 0:
                     raise EtcdError("etcdserver: requested lease not found")
                 return {"id": rsp.ID, "ttl": rsp.TTL}

@@ -522,8 +522,9 @@ class _Conn:
     def __init__(self) -> None:
         self._caller = StreamCaller()
         # real mode with a genuine broker at bootstrap.servers: the data
-        # plane rides the genuine client library (reference:
-        # madsim-rdkafka/src/lib.rs:5-12 vendoring real rdkafka)
+        # plane speaks the genuine Kafka wire protocol natively
+        # (real_client.RealKafkaConn, stdlib-only — the analogue of the
+        # reference vendoring real rdkafka, madsim-rdkafka/src/lib.rs:5-12)
         self._real = None
 
     async def open(self, addr) -> None:
@@ -550,26 +551,12 @@ class _Conn:
                    "leave_group", "describe_group"}
 
     def close(self) -> None:
-        """Release the backend: genuine-lib clients (sockets + their
-        background threads) or the sim-protocol stream fd.
-
-        Genuine-client teardown does network I/O (leave-group, flush)
-        and contends with in-flight calls on the data-plane lock, so on
-        a running event loop it is offloaded to a daemon thread instead
-        of freezing every coroutine."""
+        """Release the backend: the wire client's broker sockets or the
+        sim-protocol stream fd (both teardown paths are non-blocking)."""
         real, self._real = self._real, None
         self._caller.close()
-        if real is None:
-            return
-        import asyncio
-        import threading
-
-        try:
-            asyncio.get_running_loop()
-        except RuntimeError:
+        if real is not None:
             real.close()
-            return
-        threading.Thread(target=real.close, daemon=True).start()
 
     async def call(self, req: tuple):
         if self._real is not None:

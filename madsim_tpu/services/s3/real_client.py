@@ -520,15 +520,30 @@ async def probe_real_s3(endpoint_url: str, timeout: float = 2.0) -> Optional[Rea
     (caller falls back to the sim pickle protocol)."""
     backend = RealS3Backend.from_env(endpoint_url, timeout=timeout)
     try:
-        st, _h, _d = await backend._request("GET", "/")
+        st, headers, data = await backend._request("GET", "/")
     except Exception:
         return None
-    # any well-formed HTTP answer (200 list, 403 bad creds page, …)
-    # means there is an HTTP server here, not the pickle sim protocol
-    if 100 <= st <= 599:
-        # the short PROBE deadline must not become the per-request
-        # socket timeout for real operations (etcd learned this too)
-        backend.timeout = 30.0
-        backend.close()  # drop the probe-deadline connection
-        return backend
-    return None
+    # An HTTP answer alone is not enough — any web server would match,
+    # locking a misconfigured app onto the REST path with opaque XML
+    # errors instead of the documented sim-protocol fallback. Require an
+    # S3-specific marker: the x-amz-request-id/x-amz-id-2 headers every
+    # S3 implementation (AWS, MinIO, ceph-rgw, our gateway) sets, or an
+    # S3 XML document root (ListAllMyBucketsResult on 200, Error with an
+    # S3 error code otherwise).
+    if not (100 <= st <= 599):
+        return None
+    hdrs = {k.lower() for k in headers} if headers else set()
+    s3_marker = "x-amz-request-id" in hdrs or "x-amz-id-2" in hdrs
+    if not s3_marker and data:
+        try:
+            root_tag = _strip_ns(ET.fromstring(data).tag)
+            s3_marker = root_tag in ("ListAllMyBucketsResult", "Error")
+        except ET.ParseError:
+            s3_marker = False
+    if not s3_marker:
+        return None
+    # the short PROBE deadline must not become the per-request
+    # socket timeout for real operations (etcd learned this too)
+    backend.timeout = 30.0
+    backend.close()  # drop the probe-deadline connection
+    return backend

@@ -115,6 +115,12 @@ class S3HttpGateway:
                 head = [f"HTTP/1.1 {status} {reason}"]
                 out_headers.setdefault("content-length", str(len(out_body)))
                 out_headers.setdefault("connection", "keep-alive")
+                # S3 identity marker: every real implementation sets it,
+                # and probe_real_s3 requires it (or an S3 XML root) to
+                # distinguish a genuine store from a random HTTP server
+                out_headers.setdefault(
+                    "x-amz-request-id", f"{random.getrandbits(64):016X}"
+                )
                 head += [f"{k}: {v}" for k, v in out_headers.items()]
                 writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
                 if method != "HEAD":

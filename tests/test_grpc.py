@@ -258,6 +258,26 @@ def test_metadata_and_interceptors():
     assert run(main)
 
 
+def test_metadata_case_insensitive():
+    """Mixed-case metadata keys work on both ends (ADVICE r4: keys are
+    stored lowercase like gRPC wire metadata, but lookups must be
+    case-insensitive so sim apps using canonical HTTP casing don't get
+    silent misses)."""
+    req = grpc.Request("m", {"X-Trace-Id": "t1", "Authorization": "Bearer x"})
+    assert req.metadata["X-Trace-Id"] == "t1"
+    assert req.metadata["x-trace-id"] == "t1"
+    assert req.metadata.get("AUTHORIZATION") == "Bearer x"
+    assert "x-Trace-ID" in req.metadata
+    # wire form (what a genuine server sees) is lowercase
+    assert set(req.metadata.keys()) == {"x-trace-id", "authorization"}
+    rsp = grpc.Response("r", {"Served-By": "n1"})
+    assert rsp.metadata["served-by"] == "n1" and rsp.metadata["Served-By"] == "n1"
+    rsp.metadata["X-Extra"] = "v"
+    assert rsp.metadata.pop("x-EXTRA") == "v"
+    st = grpc.Status(grpc.Code.INTERNAL, "boom", {"Retry-After": "1"})
+    assert st.metadata.get("retry-after") == "1"
+
+
 # -- .proto ingestion (reference: madsim-tonic-build) -------------------------
 
 _REF_PROTO = "/root/reference/tonic-example/proto/helloworld.proto"

@@ -213,6 +213,36 @@ def test_kill_node_closes_sockets_and_port_released():
     assert run(main)
 
 
+def test_datagram_not_delivered_after_sender_kill():
+    # ADVICE r4 (medium): the 0-5 us processing delay runs as a timer
+    # callback; a datagram whose sender is killed between the send and
+    # the wire moment must be dropped, matching the reference where
+    # kill cancels the sender task inside rand_delay (sim/net/mod.rs:287).
+    async def main():
+        handle = Handle.current()
+        a, b = two_nodes(handle)
+        received = []
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:500")
+            while True:
+                data, _ = await ep.recv_from(1)
+                received.append(data)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ep.send_to("10.1.0.1:500", 1, b"zombie")
+
+        a.spawn(server())
+        await b.spawn(client())
+        handle.kill(b.id)  # same virtual instant: wire moment not reached
+        await sim_time.sleep(2.0)
+        return received
+
+    for seed in (1, 2, 3, 4, 5):
+        assert run(main, seed=seed) == []
+
+
 def test_udp_socket():
     async def main():
         handle = Handle.current()
