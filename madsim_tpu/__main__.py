@@ -36,6 +36,7 @@ def build_machine(name: str, nodes: int = 0):
     from .models.multipaxos import MultiPaxosMachine, NoPromiseCheckMultiPaxos
     from .models.paxos import NoPromiseCheckPaxos, PaxosMachine
     from .models.raft import RaftMachine
+    from .models.s3 import S3Machine
     from .models.twopc import TwoPcMachine
 
     class DoubleGrantEtcd(EtcdMachine):
@@ -49,6 +50,21 @@ def build_machine(name: str, nodes: int = 0):
 
     class NoDedupMvcc(EtcdMvccMachine):
         NO_DEDUP = True  # retransmits double-apply (needs storms/dir clogs)
+
+    class ArrivalOrderS3(S3Machine):
+        CONCAT_ARRIVAL_ORDER = True  # complete concats in upload order
+
+    class AbortLeakS3(S3Machine):
+        ABORT_KEEPS_PARTS = True  # abort leaks the session's parts
+
+    class EarlyExpiryS3(S3Machine):
+        LC_EARLY_HALF = True  # lifecycle expires at half the configured age
+
+    class TombstoneLeakS3(S3Machine):
+        LC_TOMBSTONE_LEAK = True  # expiry clears existence but not content
+
+    class NoDedupS3(S3Machine):
+        NO_DEDUP = True  # retried puts double-apply
 
     machines = {
         "echo": lambda: EchoMachine(rounds=10),
@@ -76,6 +92,12 @@ def build_machine(name: str, nodes: int = 0):
         "demo-nopromise-multipaxos": lambda: NoPromiseCheckMultiPaxos(
             num_nodes=nodes or 5
         ),
+        "s3": lambda: S3Machine(num_nodes=nodes or 4),
+        "demo-arrivalorder-s3": lambda: ArrivalOrderS3(num_nodes=nodes or 4),
+        "demo-abortleak-s3": lambda: AbortLeakS3(num_nodes=nodes or 4),
+        "demo-earlyexpiry-s3": lambda: EarlyExpiryS3(num_nodes=nodes or 4),
+        "demo-tombstoneleak-s3": lambda: TombstoneLeakS3(num_nodes=nodes or 4),
+        "demo-nodedup-s3": lambda: NoDedupS3(num_nodes=nodes or 4),
     }
     if name not in machines:
         sys.exit(f"unknown machine {name!r}; choose from {sorted(machines)}")
@@ -245,7 +267,25 @@ def cmd_hunt(args) -> int:
     entries = corpus.load(args.corpus)
     known = {e.key for e in entries}
     added = 0
-    for seed, code in failing[: args.limit]:
+    # Shrink one representative per distinct fail code (high-find-rate
+    # hunts surface thousands of seeds of the SAME bug; shrinking five
+    # copies of one code is pure waste). --all-seeds restores the
+    # first-N behavior for deliberately sampling one code's seeds.
+    if getattr(args, "all_seeds", False):
+        to_shrink = failing[: args.limit]
+    else:
+        by_code: dict = {}
+        for seed, code in failing:
+            by_code.setdefault(code, []).append(seed)
+        to_shrink = [(s[0], c) for c, s in sorted(by_code.items())][: args.limit]
+        shrinking = {c for _s, c in to_shrink}
+        for code, seeds_of in sorted(by_code.items()):
+            verb = (
+                f"shrinking seed {seeds_of[0]}" if code in shrinking
+                else "beyond --limit, not shrunk"
+            )
+            print(f"  code {code}: {len(seeds_of)} seeds ({verb})")
+    for seed, code in to_shrink:
         try:
             sr = shrink(eng, seed, max_steps=args.max_steps)
         except ValueError as exc:
@@ -272,8 +312,9 @@ def cmd_hunt(args) -> int:
         print(f"  + corpus: {sr.summary()}")
     if added:
         corpus.save(args.corpus, entries)
-    if failing[args.limit :]:
-        print(f"  ({len(failing) - args.limit} further failing seeds not shrunk; raise --limit)")
+    if len(to_shrink) < (len(failing) if getattr(args, "all_seeds", False)
+                         else len({c for _s, c in failing})):
+        print(f"  (further failing codes/seeds not shrunk; raise --limit)")
     print(f"{added} new entries in {args.corpus}")
     return 1 if failing else 0
 
@@ -526,6 +567,12 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=8192, help="lanes per streaming batch")
     p.add_argument("--corpus", default="corpus.json")
     p.add_argument("--limit", type=int, default=5, help="max seeds to shrink+record")
+    p.add_argument(
+        "--all-seeds",
+        action="store_true",
+        help="shrink the first --limit failing seeds even when they share "
+        "a fail code (default: one representative per distinct code)",
+    )
     p.set_defaults(fn=cmd_hunt)
 
     p = sub.add_parser(

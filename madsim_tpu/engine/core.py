@@ -360,14 +360,19 @@ class Engine:
 
     # -- one event per lane --------------------------------------------------
 
-    def lane_step(self, s: LaneState) -> LaneState:
+    def lane_step(self, s: LaneState, horizon_us=None) -> LaneState:
         idx, any_valid = pop_earliest(s.eq_time, s.eq_seq, s.eq_valid)
-        return self._lane_step_popped(s, idx, any_valid)
+        return self._lane_step_popped(s, idx, any_valid, horizon_us=horizon_us)
 
-    def _lane_step_popped(self, s: LaneState, idx, any_valid) -> LaneState:
+    def _lane_step_popped(self, s: LaneState, idx, any_valid, horizon_us=None) -> LaneState:
         """lane_step with the event-queue pop hoisted out, so step_batch
         can swap in the batched Pallas pop kernel for the whole [L, Q]
-        block while the rest of the step stays vmapped."""
+        block while the rest of the step stays vmapped.
+
+        `horizon_us` optionally overrides the config horizon with a
+        TRACED value — identical arithmetic, but one compiled replay
+        serves every horizon candidate (shrink bisects the horizon
+        per-seed; baking it would recompile per candidate)."""
         m, cfg = self.machine, self.config
 
         ev_time = s.eq_time[idx]
@@ -377,7 +382,8 @@ class Engine:
         ev_payload = s.eq_payload[idx]
 
         new_now = jnp.maximum(s.now_us, ev_time)
-        horizon_hit = any_valid & (new_now >= cfg.horizon_us)
+        hz = cfg.horizon_us if horizon_us is None else horizon_us
+        horizon_hit = any_valid & (new_now >= hz)
         process = any_valid & ~horizon_hit
         pop_mask = (jnp.arange(s.eq_valid.shape[0]) == idx) & any_valid
         eq_valid = s.eq_valid & ~pop_mask

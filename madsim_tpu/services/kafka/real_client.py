@@ -34,6 +34,7 @@ from .wire import (
     ApiKey,
     Err,
     Reader,
+    UnsupportedCodec,
     Writer,
     decode_assignment,
     decode_record_blob,
@@ -405,12 +406,23 @@ class RealKafkaConn:
                 pid = r.i32()
                 code = r.i16()
                 _hw = r.i64()
+                _lso = r.i64()  # last_stable_offset (v4+)
+                for _a in range(max(0, r.i32())):  # aborted_transactions
+                    r.i64()  # producer_id
+                    r.i64()  # first_offset
                 blob = r.bytes_() or b""
                 if code == Err.NOT_LEADER_FOR_PARTITION:
                     self._leaders.pop(topic, None)
                 if code != Err.NONE:
                     raise _err(code, f"Fetch({topic}[{partition}])")
-                for off, key, value, ts, headers in decode_record_blob(blob):
+                try:
+                    records = decode_record_blob(blob)
+                except UnsupportedCodec as exc:
+                    raise KafkaError(
+                        f"{exc} — produce with compression_type=none for the "
+                        f"stdlib wire client", ErrorCode.INVALID_ARG,
+                    ) from None
+                for off, key, value, ts, headers in records:
                     # a batch may start before the requested offset
                     if off >= offset and len(out) < max_records:
                         out.append(Message(tname, pid, off, key, value, ts, headers))

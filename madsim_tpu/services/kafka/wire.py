@@ -62,11 +62,17 @@ class ApiKey:
     CREATE_TOPICS = 19
 
 
+class UnsupportedCodec(ValueError):
+    """A record blob carries a compression codec this stdlib codec does
+    not implement — surfaced loudly instead of decoding garbage."""
+
+
 class Err:
     """Kafka numeric error codes (the subset this codec surfaces)."""
 
     NONE = 0
     OFFSET_OUT_OF_RANGE = 1
+    CORRUPT_MESSAGE = 2
     UNKNOWN_TOPIC_OR_PARTITION = 3
     NOT_LEADER_FOR_PARTITION = 6
     MESSAGE_TOO_LARGE = 10
@@ -342,7 +348,11 @@ def decode_record_blob(blob: bytes) -> List[Record]:
                 _ple = r.i32()
                 _magic = r.i8()
                 _crc = r.u32()
-                _attrs = r.i16()
+                attrs = r.i16()
+                if attrs & 0x7:  # compression codec bits
+                    raise UnsupportedCodec(
+                        f"compressed record batch (codec {attrs & 0x7}) not supported"
+                    )
                 _last_delta = r.i32()
                 first_ts = r.i64()
                 _max_ts = r.i64()
@@ -376,12 +386,18 @@ def decode_record_blob(blob: bytes) -> List[Record]:
                 _crc = r.u32()
                 _magic = r.i8()
                 _attrs = r.i8()
+                if _attrs & 0x7:  # compression codec bits
+                    raise UnsupportedCodec(
+                        f"compressed message set (codec {_attrs & 0x7}) not supported"
+                    )
                 ts_ms = r.i64() if _magic == 1 else -1
                 key = r.bytes_()
                 value = r.bytes_()
                 out.append((base_offset, key, value, ts_ms, []))
             # step exactly one entry (v2 batch already consumed fully)
             r.pos = start + 12 + size
+        except UnsupportedCodec:
+            raise  # loud: the peer used compression we cannot decode
         except (ValueError, IndexError):
             break
     return out

@@ -35,6 +35,7 @@ from .wire import (
     ApiKey,
     Err,
     Reader,
+    UnsupportedCodec,
     Writer,
     decode_record_blob,
     decode_subscription,
@@ -138,6 +139,8 @@ class KafkaWireGateway:
                 correlation_id = r.i32()
                 _client_id = r.string()
                 body = self._dispatch(api_key, api_version, r)
+                if body is None:
+                    continue  # acks=0 produce: real brokers send nothing
                 rsp = struct.pack(">i", correlation_id) + body
                 writer.write(struct.pack(">i", len(rsp)) + rsp)
                 await writer.drain()
@@ -150,7 +153,13 @@ class KafkaWireGateway:
     def _dispatch(self, api_key: int, v: int, r: Reader) -> bytes:
         now_ms = int(time.time() * 1000)
         if api_key == ApiKey.API_VERSIONS:
-            return self._api_versions()
+            # v1+ requests get UNSUPPORTED_VERSION in v0 encoding — the
+            # standard downgrade dance (librdkafka opens with v3 and
+            # retries with v0 on code 35); the version array still rides
+            # along so the client can pick without a second round-trip
+            return self._api_versions(
+                Err.UNSUPPORTED_VERSION if v > 0 else Err.NONE
+            )
         if api_key == ApiKey.METADATA:
             return self._metadata(v, r)
         if api_key == ApiKey.PRODUCE:
@@ -183,8 +192,8 @@ class KafkaWireGateway:
 
     # -- api bodies ---------------------------------------------------------
 
-    def _api_versions(self) -> bytes:
-        w = Writer().i16(Err.NONE)
+    def _api_versions(self, code: int = Err.NONE) -> bytes:
+        w = Writer().i16(code)
         w.array(_SUPPORTED, lambda t: w.i16(t[0]).i16(t[1]).i16(t[2]))
         return w.build()
 
@@ -226,10 +235,10 @@ class KafkaWireGateway:
         w.array(names, topic_entry)
         return w.build()
 
-    def _produce(self, v: int, r: Reader, now_ms: int) -> bytes:
+    def _produce(self, v: int, r: Reader, now_ms: int) -> Optional[bytes]:
         if v >= 3:
             _txn_id = r.string()
-        _acks = r.i16()
+        acks = r.i16()
         _timeout = r.i32()
         results: List[Tuple[str, List[Tuple[int, int, int]]]] = []
         for _ in range(r.i32()):
@@ -249,9 +258,13 @@ class KafkaWireGateway:
                         if base < 0:
                             base = off
                     parts.append((partition, Err.NONE, base))
+                except UnsupportedCodec:
+                    parts.append((partition, Err.CORRUPT_MESSAGE, -1))
                 except KafkaError as e:
                     parts.append((partition, _kafka_code(e), -1))
             results.append((topic, parts))
+        if acks == 0:
+            return None  # fire-and-forget: a response would desync framing
         w = Writer()
 
         def topic_entry(item):
@@ -308,9 +321,17 @@ class KafkaWireGateway:
                         if v >= 4
                         else encode_message_set(recs)
                     )
-                    w.i32(partition).i16(Err.NONE).i64(hi).bytes_(blob)
+                    w.i32(partition).i16(Err.NONE).i64(hi)
+                    if v >= 4:
+                        w.i64(hi)  # last_stable_offset (no txns)
+                        w.array([], lambda a: None)  # aborted_transactions
+                    w.bytes_(blob)
                 except KafkaError as e:
-                    w.i32(partition).i16(_kafka_code(e)).i64(-1).bytes_(b"")
+                    w.i32(partition).i16(_kafka_code(e)).i64(-1)
+                    if v >= 4:
+                        w.i64(-1)
+                        w.array([], lambda a: None)
+                    w.bytes_(b"")
 
             w.array(parts, part_entry)
 

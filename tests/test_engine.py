@@ -87,6 +87,44 @@ def test_replay_bit_identical_to_batch(raft_engine):
         assert len(rp.trace) == int(res.steps[lane])
 
 
+def test_fast_outcome_replay_matches_eager_replay(raft_engine):
+    """The single-dispatch traceless replay (replay_outcome — the shrink
+    verification workhorse) must land on the bit-exact state the eager
+    traced replay stops at, for passing and failing seeds alike, and the
+    compiled replay must be SHARED across Engines wrapping the same
+    machine (shrink builds one Engine per candidate config; per-candidate
+    recompiles were the measured hunt-throughput collapse)."""
+    import dataclasses as dc
+
+    from madsim_tpu.engine.replay import replay_outcome
+
+    for seed in (0, 3, 66531 % 7):
+        eager = replay(raft_engine, seed, max_steps=3000, trace=True)
+        fast = replay_outcome(raft_engine, seed, max_steps=3000)
+        assert int(fast.state.step) == int(eager.state.step)
+        assert int(fast.state.now_us) == int(eager.state.now_us)
+        assert bool(fast.state.failed) == bool(eager.state.failed)
+        assert int(fast.state.fail_code) == int(eager.state.fail_code)
+        for leaf_f, leaf_e in zip(
+            jax.tree.leaves(fast.state.nodes), jax.tree.leaves(eager.state.nodes)
+        ):
+            assert (jnp.asarray(leaf_f) == jnp.asarray(leaf_e)).all()
+
+    # same machine, different horizon/fault-count config: no new cache
+    # entry for the fast path (horizon + max_steps are traced, n_faults
+    # only shapes init) — candidate verification is compile-free
+    cache = raft_engine.machine.__dict__["_replay_jit_cache"]
+    n_before = len(cache)
+    cand_cfg = dc.replace(
+        raft_engine.config,
+        horizon_us=123_456,
+        faults=dc.replace(raft_engine.config.faults, n_faults=0),
+    )
+    cand = Engine(raft_engine.machine, cand_cfg)
+    replay_outcome(cand, 3, max_steps=777)
+    assert len(cache) == n_before
+
+
 def test_buggy_protocol_found_and_replayed(raft_engine):
     """A Raft variant that grants votes it shouldn't must trip
     ElectionSafety on some seeds; the failing seed replays identically."""

@@ -286,6 +286,95 @@ def test_gateway_serves_pre_011_clients_message_set():
     assert asyncio.run(main())
 
 
+def test_gateway_wire_conformance_edges():
+    """Protocol edges genuine clients depend on: ApiVersions v1+ gets
+    UNSUPPORTED_VERSION (the downgrade dance), acks=0 produce gets NO
+    response (a reply would desync framing), compressed produce is
+    rejected loudly instead of acked-and-dropped, and Fetch v4 carries
+    last_stable_offset/aborted_transactions."""
+    import asyncio as aio
+
+    from madsim_tpu.services.kafka.real_client import _BrokerWire
+    from madsim_tpu.services.kafka.wire import encode_record_batch
+
+    async def main():
+        gw = KafkaWireGateway()
+        port = await gw.start()
+        gw.broker.create_topic("edge", 1)
+        wire = _BrokerWire("127.0.0.1", port)
+        try:
+            # ApiVersions v1 -> UNSUPPORTED_VERSION + the version array
+            r = await wire.call(ApiKey.API_VERSIONS, 1, b"")
+            assert r.i16() == Err.UNSUPPORTED_VERSION
+            assert r.i32() > 0  # array still present for the downgrade
+
+            # compressed record batch -> CORRUPT_MESSAGE, nothing stored
+            blob = bytearray(encode_record_batch([(0, None, b"x", 1, [])]))
+            # attributes i16 lives at offset 8+4+4+1+4 = 21; set gzip
+            # in its low byte (22)
+            blob[22] |= 1
+            w = Writer()
+            w.string(None).i16(-1).i32(10_000)
+
+            def t1(t):
+                w.string(t)
+
+                def part(p):
+                    w.i32(p).bytes_(bytes(blob))
+
+                w.array([0], part)
+
+            w.array(["edge"], t1)
+            r = await wire.call(ApiKey.PRODUCE, 3, w.build())
+            assert r.i32() == 1 and r.string() == "edge" and r.i32() == 1
+            assert (r.i32(), r.i16()) == (0, Err.CORRUPT_MESSAGE)
+            assert gw.broker.watermarks("edge", 0) == (0, 0)
+
+            # acks=0 produce: no response; the next call must still pair
+            # correctly on the same connection
+            w = Writer()
+            w.string(None).i16(0).i32(10_000)
+
+            def t2(t):
+                w.string(t)
+
+                def part(p):
+                    w.i32(p).bytes_(encode_record_batch([(0, None, b"fire", 5, [])]))
+
+                w.array([0], part)
+
+            w.array(["edge"], t2)
+            async with wire._lock:  # raw send, no response expected
+                if wire._writer is None:
+                    wire._reader, wire._writer = await aio.open_connection(
+                        wire.host, wire.port
+                    )
+                wire._corr += 1
+                head = (
+                    Writer().i16(ApiKey.PRODUCE).i16(3).i32(wire._corr)
+                    .string(wire.client_id).build()
+                )
+                frame = head + w.build()
+                wire._writer.write(struct.pack(">i", len(frame)) + frame)
+                await wire._writer.drain()
+            # the produce landed...
+            conn = RealKafkaConn(f"127.0.0.1:{port}")
+            try:
+                msgs = await conn.call(("fetch", "edge", 0, 0, 10))
+                assert [m.payload for m in msgs] == [b"fire"]
+            finally:
+                conn.close()
+            # ...and the SAME socket still pairs requests/responses
+            r = await wire.call(ApiKey.API_VERSIONS, 0, b"")
+            assert r.i16() == Err.NONE
+        finally:
+            wire.close()
+            await gw.stop()
+        return True
+
+    assert asyncio.run(main())
+
+
 def test_real_mode_public_surface_against_gateway():
     """The public client surface (ClientConfig -> producer/consumer with
     group.id) in real mode, through the connect probe, against the
