@@ -30,6 +30,7 @@ def build_machine(name: str, nodes: int = 0):
     from .models.echo import EchoMachine
     from .models.etcd import EtcdMachine
     from .models.etcd_mvcc import EtcdMvccMachine
+    from .models.gossip import GossipMachine
     from .models.kafka_group import KafkaGroupMachine, NoFencingGroupMachine
     from .models.kv import KvMachine
     from .models.mq import MqMachine
@@ -51,6 +52,10 @@ def build_machine(name: str, nodes: int = 0):
     class NoDedupMvcc(EtcdMvccMachine):
         NO_DEDUP = True  # retransmits double-apply (needs storms/dir clogs)
 
+    class PrematureGiveupMvcc(EtcdMvccMachine):
+        PREMATURE_GIVEUP = True  # deadline-RPC timeout mishandling
+        #                          (reachable only by the delay kind)
+
     class ArrivalOrderS3(S3Machine):
         CONCAT_ARRIVAL_ORDER = True  # complete concats in upload order
 
@@ -65,6 +70,9 @@ def build_machine(name: str, nodes: int = 0):
 
     class NoDedupS3(S3Machine):
         NO_DEDUP = True  # retried puts double-apply
+
+    class DupAckGossip(GossipMachine):
+        DUP_ACK_COUNT = True  # quorum tally counts duplicate acks
 
     machines = {
         "echo": lambda: EchoMachine(rounds=10),
@@ -89,10 +97,13 @@ def build_machine(name: str, nodes: int = 0):
             num_nodes=nodes or 5, log_capacity=8
         ),
         "demo-nodedup-mvcc": lambda: NoDedupMvcc(num_nodes=nodes or 4),
+        "demo-giveup-mvcc": lambda: PrematureGiveupMvcc(num_nodes=nodes or 4),
         "demo-nopromise-multipaxos": lambda: NoPromiseCheckMultiPaxos(
             num_nodes=nodes or 5
         ),
         "s3": lambda: S3Machine(num_nodes=nodes or 4),
+        "gossip": lambda: GossipMachine(num_nodes=nodes or 33),
+        "demo-dupack-gossip": lambda: DupAckGossip(num_nodes=nodes or 33),
         "demo-arrivalorder-s3": lambda: ArrivalOrderS3(num_nodes=nodes or 4),
         "demo-abortleak-s3": lambda: AbortLeakS3(num_nodes=nodes or 4),
         "demo-earlyexpiry-s3": lambda: EarlyExpiryS3(num_nodes=nodes or 4),
@@ -132,7 +143,7 @@ def _fault_kind_flags(args) -> dict:
     # argsets may lack the flag; absent == legacy pair,kill
     raw = getattr(args, "fault_kinds", "pair,kill")
     kinds = {k.strip() for k in raw.split(",") if k.strip()}
-    known = {"pair", "kill", "dir", "group", "storm"}
+    known = {"pair", "kill", "dir", "group", "storm", "delay"}
     if not kinds <= known:
         sys.exit(f"unknown fault kinds {sorted(kinds - known)}; choose from {sorted(known)}")
     return {
@@ -141,6 +152,7 @@ def _fault_kind_flags(args) -> dict:
         "allow_dir_clog": "dir" in kinds,
         "allow_group": "group" in kinds,
         "allow_storm": "storm" in kinds,
+        "allow_delay": "delay" in kinds,
     }
 
 
@@ -530,8 +542,8 @@ def main(argv=None) -> int:
         p.add_argument(
             "--fault-kinds", default="pair,kill",
             help="comma list of fault kinds to draw from: "
-            "pair,kill,dir,group,storm (default pair,kill; any other "
-            "kind switches to the v2 schedule derivation)",
+            "pair,kill,dir,group,storm,delay (default pair,kill; any "
+            "other kind switches to the v2 schedule derivation)",
         )
 
     p = sub.add_parser("explore", help="run a seed batch, report failing seeds")

@@ -21,12 +21,24 @@ class NetConfig:
     # Uniform per-packet latency range [min, max) in nanoseconds.
     send_latency_min_ns: int = 1_000_000  # 1 ms
     send_latency_max_ns: int = 10_000_000  # 10 ms
+    # Delay-spike window (the runtime-togglable twin of the buggified
+    # 1-5 s rand_delay, reference sim/net/mod.rs:287-296): while > 0,
+    # each packet independently takes +[spike_min, spike_max) ns of
+    # latency with this probability. The device engine's K_DELAY fault
+    # kind maps onto these knobs (differential.py).
+    delay_spike_prob: float = 0.0
+    delay_spike_min_ns: int = 1_000_000_000  # 1 s
+    delay_spike_max_ns: int = 5_000_000_000  # 5 s
 
     def validate(self) -> None:
         if not (0.0 <= self.packet_loss_rate <= 1.0):
             raise ValueError("packet_loss_rate must be in [0, 1]")
         if self.send_latency_max_ns < self.send_latency_min_ns:
             raise ValueError("send_latency_max_ns < send_latency_min_ns")
+        if not (0.0 <= self.delay_spike_prob <= 1.0):
+            raise ValueError("delay_spike_prob must be in [0, 1]")
+        if self.delay_spike_max_ns < self.delay_spike_min_ns:
+            raise ValueError("delay_spike_max_ns < delay_spike_min_ns")
 
 
 @dataclass

@@ -41,6 +41,8 @@ from .engine.core import (
     F_CLOG_DIR,
     F_CLOG_GROUP,
     F_CLOG_PAIR,
+    F_DELAY_END,
+    F_DELAY_SPIKE,
     F_KILL,
     F_LOSS_END,
     F_LOSS_STORM,
@@ -141,9 +143,13 @@ def run_host_raft(
             applied = state.setdefault("chaos_applied", [])
             start = sim_time.now()
 
-            def group_split(mask):
-                g = [ids[i] for i in range(n) if (mask >> i) & 1]
-                rest = [ids[i] for i in range(n) if not (mask >> i) & 1]
+            def group_split(mask_lo, mask_hi):
+                # two-word mask: lo carries bits [0, 30), hi [30, 60)
+                def bit(i):
+                    return (mask_lo >> i) & 1 if i < 30 else (mask_hi >> (i - 30)) & 1
+
+                g = [ids[i] for i in range(n) if bit(i)]
+                rest = [ids[i] for i in range(n) if not bit(i)]
                 return g, rest
 
             for ev in schedule:
@@ -165,9 +171,9 @@ def run_host_raft(
                 elif op == F_UNCLOG_DIR:
                     net.unclog_link(ids[a], ids[b])
                 elif op == F_CLOG_GROUP:
-                    net.partition(*group_split(a))
+                    net.partition(*group_split(a, b))
                 elif op == F_UNCLOG_GROUP:
-                    net.heal(*group_split(a))
+                    net.heal(*group_split(a, b))
                 elif op == F_LOSS_STORM:
                     rate = min(1.0, base_loss + a / 65536.0)
                     net.config.net.packet_loss_rate = rate
@@ -175,6 +181,15 @@ def run_host_raft(
                 elif op == F_LOSS_END:
                     net.config.net.packet_loss_rate = base_loss
                     state["loss_trace"].append((ev["t_us"], base_loss))
+                elif op == F_DELAY_SPIKE:
+                    # device K_DELAY window: ~10% of packets +1-5 s
+                    # (the engine's DELAY_PROB/EXTRA constants mirror
+                    # these fabric knobs — one semantics, two engines)
+                    net.config.net.delay_spike_prob = 0.1
+                    state.setdefault("delay_trace", []).append((ev["t_us"], 0.1))
+                elif op == F_DELAY_END:
+                    net.config.net.delay_spike_prob = 0.0
+                    state.setdefault("delay_trace", []).append((ev["t_us"], 0.0))
                 applied.append((ev["t_us"], op, a, b))
 
         spawn(chaos())
@@ -203,6 +218,7 @@ def run_host_raft(
             "max_commit": state.get("max_commit", 0),
             "chaos_applied": list(state.get("chaos_applied", [])),
             "loss_trace": list(state.get("loss_trace", [])),
+            "delay_trace": list(state.get("delay_trace", [])),
         }
 
     return Runtime(seed=seed).block_on(scenario())

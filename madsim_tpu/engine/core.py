@@ -58,6 +58,9 @@ F_CLOG_GROUP = 6  # group partition: payload[1] is a node bitmask; every
 F_UNCLOG_GROUP = 7  # link crossing the group boundary clogs both ways
 F_LOSS_STORM = 8  # timed packet-loss storm: payload[1] = rate in 1/65536
 F_LOSS_END = 9
+F_DELAY_SPIKE = 10  # timed delay-spike window: ~10% of sends +1-5 virt s
+F_DELAY_END = 11    # (the device analogue of the host buggify delay,
+#                     reference sim/net/mod.rs:287-296)
 
 # FaultPlan kind indices (op_apply = 2*kind)
 K_PAIR = 0
@@ -65,6 +68,13 @@ K_KILL = 1
 K_DIR = 2
 K_GROUP = 3
 K_STORM = 4
+K_DELAY = 5
+
+# delay-spike parameters — the host fabric's buggify numbers
+# (net/__init__.py rand_delay: 10% of sends suspended 1-5 s)
+DELAY_PROB_U32 = int(0.1 * 0xFFFFFFFF)
+DELAY_EXTRA_MIN_US = 1_000_000
+DELAY_EXTRA_SPAN_US = 4_000_001
 
 # Failure codes
 OK = 0
@@ -84,6 +94,11 @@ class FaultPlan:
         (covers majority/minority splits; bitmask-encoded)
       * storm: raise the packet-loss rate to `storm_loss_u16`/65536 for
         the duration (timed loss storm on top of the static config rate)
+      * delay: a delay-spike window — while active, ~10% of sent
+        messages take +1-5 virtual seconds of extra latency (the device
+        analogue of the host fabric's buggified rand_delay, reference
+        sim/net/mod.rs:287-296; late-but-delivered messages find
+        timeout-handling bugs that loss cannot)
 
     The legacy two-kind derivation (partition/kill only) is byte-stable:
     seeds found by earlier sweeps (e.g. the 66531 LOG_MATCHING
@@ -97,6 +112,7 @@ class FaultPlan:
     allow_dir_clog: bool = False
     allow_group: bool = False
     allow_storm: bool = False
+    allow_delay: bool = False  # timed delay-spike windows (buggify analogue)
     storm_loss_u16: int = 52428  # ~80% loss while a storm is active
     t_min_us: int = 0
     t_max_us: int = 1_000_000
@@ -115,11 +131,16 @@ class FaultPlan:
             kinds.append(K_GROUP)
         if self.allow_storm:
             kinds.append(K_STORM)
+        if self.allow_delay:
+            kinds.append(K_DELAY)
         return tuple(kinds)
 
     @property
     def uses_v2_kinds(self) -> bool:
-        return self.allow_dir_clog or self.allow_group or self.allow_storm
+        return (
+            self.allow_dir_clog or self.allow_group or self.allow_storm
+            or self.allow_delay
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,6 +173,7 @@ class LaneState:
     horizon_hit: jax.Array
     msg_count: jax.Array
     storm_loss: jax.Array  # int32: active storm loss rate in 1/65536 (0 = none)
+    delay_spike: jax.Array  # int32: 1 while a delay-spike window is active
     eq_time: jax.Array  # int32[Q]
     eq_seq: jax.Array  # int32[Q]
     eq_kind: jax.Array  # int32[Q]
@@ -219,8 +241,11 @@ class Engine:
         fp = config.faults
         if fp.n_faults > 0 and not fp.enabled_kinds():
             raise ValueError("FaultPlan has n_faults > 0 but every kind disabled")
-        if fp.allow_group and (n < 2 or n > 30):
-            raise ValueError("group partitions need 2 <= NUM_NODES <= 30 (int32 bitmask)")
+        if fp.allow_group and (n < 2 or n > 60):
+            raise ValueError(
+                "group partitions need 2 <= NUM_NODES <= 60 (two-word "
+                "int32 bitmask: payload args 1+2 carry 30 bits each)"
+            )
         if not 0 <= fp.storm_loss_u16 <= 65535:
             raise ValueError("storm_loss_u16 must be in [0, 65535]")
 
@@ -292,23 +317,33 @@ class Engine:
                 b = (a + b_off) % n
                 kinds = jnp.asarray(fp.enabled_kinds(), jnp.int32)
                 kind = kinds[jax.random.bits(k5, (), jnp.uint32) % jnp.uint32(len(kinds))]
-                # non-trivial bitmask: at least one node on each side.
-                # Clamp the modulus to 30 bits: the draw happens
-                # unconditionally (constant draw count), so without the
-                # clamp a dir/storm-only plan on n > 32 nodes would
-                # overflow uint32 at lane init even though allow_group
-                # is gated to 2 <= n <= 30.
-                mask = 1 + (
-                    jax.random.bits(k6, (), jnp.uint32) % jnp.uint32(2 ** min(n, 30) - 2)
+                # Group masks: payload arg1 carries bits [0, 30), arg2
+                # bits [30, 60) — two int32 words, so group partitions
+                # scale past the old 30-node cap (lifted round 5; the
+                # constructor rejects n > 60). The low draw keeps the
+                # historical ≤30-node derivation byte-stable; the high
+                # word is drawn ONLY for n > 30 machines (new since the
+                # lift), so recorded seeds replay unchanged.
+                lo_bits = min(n, 30)
+                mask_lo = 1 + (
+                    jax.random.bits(k6, (), jnp.uint32) % jnp.uint32(2 ** lo_bits - 2)
                 ).astype(jnp.int32)
+                if n > 30:
+                    k_faults, k7 = jax.random.split(k_faults)
+                    hi_bits = n - 30
+                    mask_hi = (
+                        jax.random.bits(k7, (), jnp.uint32) % jnp.uint32(2 ** hi_bits)
+                    ).astype(jnp.int32)
+                else:
+                    mask_hi = jnp.int32(0)
                 op_apply = (2 * kind).astype(jnp.int32)
                 op_undo = (2 * kind + 1).astype(jnp.int32)
                 arg1 = jnp.where(
                     kind == K_GROUP,
-                    mask,
+                    mask_lo,
                     jnp.where(kind == K_STORM, jnp.int32(fp.storm_loss_u16), a),
                 )
-                arg2 = b
+                arg2 = jnp.where(kind == K_GROUP, mask_hi, b)
             for slot_off, (tt, op) in enumerate([(t, op_apply), (t + dur, op_undo)]):
                 i = n + 2 * f + slot_off
                 msk = slots == i
@@ -332,6 +367,7 @@ class Engine:
             horizon_hit=jnp.bool_(False),
             msg_count=jnp.int32(0),
             storm_loss=jnp.int32(0),
+            delay_spike=jnp.int32(0),
             eq_time=eq_time,
             eq_seq=eq_seq,
             eq_kind=eq_kind,
@@ -403,10 +439,13 @@ class Engine:
             }
 
         # One batched draw covers the step's randomness (handler words,
-        # per-message latency + drop draws); k_restart is its own split —
-        # never derived from a consumed key (stream-collision hazard).
+        # per-message latency + drop draws, and — only when the delay
+        # kind is enabled, so historical seeds keep their streams —
+        # per-message spike draws); k_restart is its own split — never
+        # derived from a consumed key (stream-collision hazard).
         key, k_step, k_restart = jax.random.split(s.rng_key, 3)
-        n_words = cfg.handler_rand_words + 2 * m.MAX_MSGS
+        with_delay = cfg.faults.allow_delay
+        n_words = cfg.handler_rand_words + (4 if with_delay else 2) * m.MAX_MSGS
         step_words = jax.random.bits(k_step, (n_words,), jnp.uint32)
         rand_u32 = step_words[: cfg.handler_rand_words]
 
@@ -414,11 +453,11 @@ class Engine:
 
         def timer_branch(_):
             nodes, outbox = m.on_timer(s.nodes, ev_node, ev_payload[0], new_now, rand_u32)
-            return nodes, outbox, s.clogged, s.killed, s.storm_loss, jnp.int32(-1)
+            return nodes, outbox, s.clogged, s.killed, s.storm_loss, s.delay_spike, jnp.int32(-1)
 
         def msg_branch(_):
             nodes, outbox = m.on_message(s.nodes, ev_node, ev_src, ev_payload, new_now, rand_u32)
-            return nodes, outbox, s.clogged, s.killed, s.storm_loss, jnp.int32(-1)
+            return nodes, outbox, s.clogged, s.killed, s.storm_loss, s.delay_spike, jnp.int32(-1)
 
         def fault_branch(_):
             op, a, b = ev_payload[0], ev_payload[1], ev_payload[2]
@@ -435,9 +474,15 @@ class Engine:
             dir_val = op == F_CLOG_DIR
             touch_dir = (op == F_CLOG_DIR) | (op == F_UNCLOG_DIR)
             clogged = jnp.where(touch_dir, set2d(clogged, a, b, dir_val), clogged)
-            # group partition: `a` is a node bitmask; clog/heal every link
-            # crossing the group boundary (covers majority/minority splits)
-            in_g = ((a >> jnp.arange(nn)) & 1).astype(bool)
+            # group partition: `a` carries mask bits [0, 30), `b` bits
+            # [30, 60); clog/heal every link crossing the group boundary
+            # (covers majority/minority splits at any supported n)
+            idxs = jnp.arange(nn)
+            in_g = jnp.where(
+                idxs < 30,
+                (a >> jnp.clip(idxs, 0, 29)) & 1,
+                (b >> jnp.clip(idxs - 30, 0, 29)) & 1,
+            ).astype(bool)
             cross = in_g[:, None] != in_g[None, :]
             touch_group = (op == F_CLOG_GROUP) | (op == F_UNCLOG_GROUP)
             clogged = jnp.where(touch_group & cross, op == F_CLOG_GROUP, clogged)
@@ -453,15 +498,21 @@ class Engine:
                 a,
                 jnp.where(op == F_LOSS_END, jnp.int32(0), s.storm_loss),
             ).astype(jnp.int32)
+            # delay-spike window toggle (buggify analogue)
+            delay = jnp.where(
+                op == F_DELAY_SPIKE,
+                jnp.int32(1),
+                jnp.where(op == F_DELAY_END, jnp.int32(0), s.delay_spike),
+            ).astype(jnp.int32)
             # cond folded into the machine's own row masks — no full-tree
             # select here (XLA CSEs it inside the fused loop, but eager
             # step_batch paid ~30% for it, and masked writes are strictly
             # less work for any backend)
             nodes = m.restart_node_if(s.nodes, a, op == F_RESTART, k_restart)
             boot_node = jnp.where(op == F_RESTART, a, jnp.int32(-1))
-            return nodes, m.empty_outbox(), clogged, killed, storm, boot_node
+            return nodes, m.empty_outbox(), clogged, killed, storm, delay, boot_node
 
-        nodes, outbox, clogged, killed, storm_loss, boot_node = lax.switch(
+        nodes, outbox, clogged, killed, storm_loss, delay_spike, boot_node = lax.switch(
             ev_kind, [timer_branch, msg_branch, fault_branch], None
         )
 
@@ -473,6 +524,7 @@ class Engine:
         clogged = jnp.where(effective, clogged, s.clogged)
         killed = jnp.where(effective, killed, s.killed)
         storm_loss = jnp.where(effective, storm_loss, s.storm_loss)
+        delay_spike = jnp.where(effective, delay_spike, s.delay_spike)
         outbox_valid_msgs = outbox.msg_valid & effective
         outbox_valid_timers = outbox.timer_valid & effective
 
@@ -493,7 +545,23 @@ class Engine:
 
         lat_span = max(1, cfg.latency_max_us - cfg.latency_min_us)
         lat_bits = step_words[cfg.handler_rand_words : cfg.handler_rand_words + m.MAX_MSGS]
-        drop_bits = step_words[cfg.handler_rand_words + m.MAX_MSGS :]
+        drop_bits = step_words[
+            cfg.handler_rand_words + m.MAX_MSGS : cfg.handler_rand_words + 2 * m.MAX_MSGS
+        ]
+        # spike gate + magnitude are INDEPENDENT words: conditioning the
+        # magnitude on the gate's sub-threshold bits would cap the extra
+        # latency at ~2.7 s instead of the documented 1-5 s
+        spike_bits = (
+            step_words[
+                cfg.handler_rand_words + 2 * m.MAX_MSGS :
+                cfg.handler_rand_words + 3 * m.MAX_MSGS
+            ]
+            if with_delay
+            else None
+        )
+        spike_mag_bits = (
+            step_words[cfg.handler_rand_words + 3 * m.MAX_MSGS :] if with_delay else None
+        )
         # static config loss + active storm (storm rate 65535 ~= drop all),
         # saturating at u32 max
         base_threshold = jnp.uint32(int(cfg.packet_loss_rate * 0xFFFFFFFF))
@@ -510,6 +578,15 @@ class Engine:
             latency = jnp.int32(cfg.latency_min_us) + (
                 lat_bits[mi] % jnp.uint32(lat_span)
             ).astype(jnp.int32)
+            if with_delay:
+                # delay-spike window: ~10% of sends take +1-5 virtual s
+                # (the host buggify's numbers); the draws are consumed
+                # every step so windows don't perturb the stream shape
+                spiked = (delay_spike > 0) & (spike_bits[mi] < jnp.uint32(DELAY_PROB_U32))
+                extra = jnp.int32(DELAY_EXTRA_MIN_US) + (
+                    spike_mag_bits[mi] % jnp.uint32(DELAY_EXTRA_SPAN_US)
+                ).astype(jnp.int32)
+                latency = latency + jnp.where(spiked, extra, 0)
             slot, has_free = find_free_slot(eq["valid"])
             overflow = do_push & ~has_free
             failed = failed | overflow
@@ -564,6 +641,7 @@ class Engine:
             horizon_hit=s.horizon_hit | horizon_hit,
             msg_count=msg_count,
             storm_loss=storm_loss,
+            delay_spike=delay_spike,
             eq_time=eq["time"],
             eq_seq=eq["seq"],
             eq_kind=eq["kind"],

@@ -137,6 +137,7 @@ def _trace_affecting_key(engine: Engine) -> tuple:
         cfg.packet_loss_rate,
         cfg.handler_rand_words,
         cfg.trace_ring,
+        cfg.faults.allow_delay,  # changes the per-step RNG word count
         engine.use_pallas_pop,
     )
 

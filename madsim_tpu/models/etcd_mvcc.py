@@ -46,6 +46,21 @@ Seeded bug variants (class flags, each a real etcd-class defect):
                         not the expiry the sweep consults (classic
                         lease bug); caught by LEASE_EARLY's ghost
                         `real_expire` the moment the sweep fires early.
+  * PREMATURE_GIVEUP  — deadline-RPC client against a token-dedup
+                        server: each op is sent ONCE with a 300 ms
+                        deadline; on timeout the client reports FAILURE
+                        to the application and moves on (timeout
+                        mishandling), and the server dedups by
+                        idempotency token (per-seq bitmap — exactly-once
+                        per token, so a late DISTINCT token still
+                        applies). The safety breach is an abandoned op
+                        applying AFTER its failure was reported — a
+                        write the application compensated for becomes
+                        visible. The in-flight request must OUTLIVE the
+                        give-up moment: loss destroys it, clogs/kills
+                        block it at the link, so the class is reachable
+                        ONLY by the K_DELAY spike (late but delivered) —
+                        the delay vocabulary's exclusive find.
 """
 
 from __future__ import annotations
@@ -87,8 +102,10 @@ TXN_ATOMICITY = 202
 LEASE_EARLY = 203
 DUP_APPLY = 204
 MVCC_ORDER = 205
+ABANDONED_WRITE = 206  # an op the client abandoned (reported failed) applied
 
 RETRY_US = 100_000  # client retry/op-issue tick
+GIVEUP_US = 300_000  # PREMATURE_GIVEUP variant: report failure after this
 TTL_MIN_US = 300_000  # granted lease TTLs
 TTL_SPAN_US = 500_000
 
@@ -118,6 +135,13 @@ class MvccState:
     acked: jax.Array          # int32[N] highest acked seq
     opk: jax.Array            # int32[N] current op kind
     oparg: jax.Array          # int32[N] current op arg (ttl for grant)
+    issued_at: jax.Array      # int32[N] when the in-flight op was issued
+    abandoned_seq: jax.Array  # int32[N] ghost: highest seq reported FAILED
+    dirty_abandoned: jax.Array  # bool[N] ghost flag (server row): an
+    #                             abandoned op applied post-abandonment
+    applied_bits: jax.Array   # int32[N, 4] server token-dedup bitmap
+    #                           (PREMATURE_GIVEUP's exactly-once-per-
+    #                           token server; 128 seqs per client)
     puts_sent: jax.Array      # int32[N, K] ghost: unique put ops issued per key
     # --- bookkeeping ---------------------------------------------------
     epoch: jax.Array          # int32[N] timer epoch (invalidates stale timers)
@@ -133,6 +157,7 @@ class EtcdMvccMachine(Machine):
     # seeded bug variants (see module docstring)
     NO_DEDUP = False
     KEEPALIVE_NO_EXTEND = False
+    PREMATURE_GIVEUP = False
 
     def __init__(self, num_nodes: int = 4, target_ops: int = 6):
         self.NUM_NODES = num_nodes
@@ -157,6 +182,9 @@ class EtcdMvccMachine(Machine):
             last_req=zl,
             early_expiry=jnp.zeros((n,), bool),
             seq=zn, acked=zn, opk=zn, oparg=zn,
+            issued_at=zn, abandoned_seq=zn,
+            dirty_abandoned=jnp.zeros((n,), bool),
+            applied_bits=jnp.zeros((n, 4), jnp.int32),
             puts_sent=zk,
             epoch=zn,
         )
@@ -186,8 +214,25 @@ class EtcdMvccMachine(Machine):
         done_c = nodes.acked[node] >= self.target_ops
         act = live & is_client & ~done_c
 
-        # issue the next op once the current one is acked
-        need_new = act & (nodes.acked[node] == nodes.seq[node])
+        # PREMATURE_GIVEUP variant (timeout mishandling): after GIVEUP_US
+        # without an ack the client reports the op FAILED and moves on.
+        # The ghost records the abandoned seq; the server flags any
+        # post-abandonment apply of it (ABANDONED_WRITE).
+        give_up = (
+            jnp.bool_(self.PREMATURE_GIVEUP)
+            & act
+            & (nodes.seq[node] > nodes.acked[node])
+            & (now_us - nodes.issued_at[node] >= GIVEUP_US)
+        )
+        nodes = update_node(
+            nodes, node,
+            abandoned_seq=jnp.where(
+                give_up, nodes.seq[node], nodes.abandoned_seq[node]
+            ),
+        )
+
+        # issue the next op once the current one is acked (or abandoned)
+        need_new = act & ((nodes.acked[node] == nodes.seq[node]) | give_up)
         new_seq = nodes.seq[node] + 1
         kind = (rand_u32[0] % jnp.uint32(N_OPS)).astype(jnp.int32)
         ttl = jnp.int32(TTL_MIN_US) + (rand_u32[1] % jnp.uint32(TTL_SPAN_US)).astype(jnp.int32)
@@ -202,10 +247,18 @@ class EtcdMvccMachine(Machine):
             nodes.puts_sent,
         )
         nodes = nodes.replace(puts_sent=puts_sent)
-        nodes = update_node(nodes, node, seq=seq_p, opk=opk_p, oparg=arg_p)
+        nodes = update_node(
+            nodes, node, seq=seq_p, opk=opk_p, oparg=arg_p,
+            issued_at=jnp.where(need_new, now_us, nodes.issued_at[node]),
+        )
 
-        # (re)send the in-flight op; re-arm the retry chain
+        # (re)send the in-flight op; re-arm the retry chain. The
+        # PREMATURE_GIVEUP variant is a deadline-RPC client: each op is
+        # sent exactly once at issue (no retransmits — the deadline,
+        # not the retry loop, handles "failure").
         send = act & (seq_p > nodes.acked[node])
+        if self.PREMATURE_GIVEUP:
+            send = send & need_new
         outbox = send_if(
             outbox, 0, send, SERVER,
             make_payload(self.PAYLOAD_WIDTH, M_REQ, seq_p, opk_p, arg_p),
@@ -341,15 +394,51 @@ class EtcdMvccMachine(Machine):
         is_req = (node == SERVER) & (mtype == M_REQ)
         swept = self._sweep(nodes, now_us)
         slot = jnp.clip(src - 1, 0, self.L - 1)
-        is_dup = jnp.where(
-            jnp.bool_(self.NO_DEDUP), jnp.bool_(False), seq <= swept.last_req[SERVER, slot]
-        )
+        if self.PREMATURE_GIVEUP:
+            # token-dedup server (exactly-once per idempotency token): a
+            # late DISTINCT seq still applies — which is precisely what
+            # lets an abandoned op land after its failure was reported.
+            # Deadline-RPC clients send each token exactly once, so a
+            # seq past the 128-bit window is simply never a duplicate
+            # (no clip-aliasing: out-of-window tokens apply unmarked).
+            in_window = seq < 128
+            word = jnp.clip(seq // 32, 0, 3)
+            bit = jnp.int32(1) << jnp.clip(seq % 32, 0, 31)
+            is_dup = in_window & ((swept.applied_bits[src, word] & bit) != 0)
+        else:
+            is_dup = jnp.where(
+                jnp.bool_(self.NO_DEDUP), jnp.bool_(False),
+                seq <= swept.last_req[SERVER, slot],
+            )
         applied, status = self._apply(swept, src, seq, payload[2], payload[3], now_us)
         applied = applied.replace(
             last_req=set2d(
                 applied.last_req, SERVER, slot,
                 jnp.maximum(applied.last_req[SERVER, slot], seq),
             )
+        )
+        if self.PREMATURE_GIVEUP:
+            token_row = (
+                (jnp.arange(self.NUM_NODES)[:, None] == src)
+                & (jnp.arange(4)[None, :] == word)
+                & in_window
+            )
+            applied = applied.replace(
+                applied_bits=jnp.where(
+                    token_row, applied.applied_bits | bit, applied.applied_bits
+                )
+            )
+        # ghost: applying an op its client already reported as FAILED is
+        # the PREMATURE_GIVEUP safety breach (a compensated-for write
+        # becoming visible) — only reachable by a late-but-delivered
+        # request, i.e. the delay-spike fault kind
+        late_abandoned = seq <= applied.abandoned_seq[src]
+        applied = applied.replace(
+            dirty_abandoned=jnp.where(
+                (jnp.arange(self.NUM_NODES) == SERVER) & late_abandoned,
+                True,
+                applied.dirty_abandoned,
+            ),
         )
         # select: request => swept(+applied unless dup); else untouched
         do_apply = is_req & ~is_dup
@@ -405,18 +494,28 @@ class EtcdMvccMachine(Machine):
             )
         )
 
-        ok = ~(rev_skew | txn_div | early | dup | order)
+        dirty = nodes.dirty_abandoned[SERVER]
+
+        ok = ~(rev_skew | txn_div | early | dup | order | dirty)
         code = jnp.where(
             rev_skew, REV_SKEW,
             jnp.where(txn_div, TXN_ATOMICITY,
                       jnp.where(early, LEASE_EARLY,
                                 jnp.where(dup, DUP_APPLY,
-                                          jnp.where(order, MVCC_ORDER, 0)))),
+                                          jnp.where(order, MVCC_ORDER,
+                                                    jnp.where(dirty, ABANDONED_WRITE, 0))))),
         )
         return ok, code.astype(jnp.int32)
 
     def is_done(self, nodes: MvccState, now_us):
-        return jnp.all(nodes.acked[1:] >= self.target_ops)
+        base = jnp.all(nodes.acked[1:] >= self.target_ops)
+        if self.PREMATURE_GIVEUP:
+            # deadline-RPC semantics: an abandoned request can still be
+            # in flight (spiked up to 5 s); hold the lane open so the
+            # late arrival is observed — once the event queue drains the
+            # engine completes the lane anyway (done |= ~any_valid)
+            return base & (now_us >= jnp.int32(7_000_000))
+        return base
 
     def summary(self, nodes: MvccState):
         return {

@@ -157,6 +157,13 @@ class Network:
         latency = self.rng.gen_range(
             self.config.send_latency_min_ns, self.config.send_latency_max_ns + 1
         )
+        if self.config.delay_spike_prob > 0.0 and self.rng.gen_bool(
+            self.config.delay_spike_prob
+        ):
+            # delay-spike window (config.py NetConfig): late, not lost
+            latency += self.rng.gen_range(
+                self.config.delay_spike_min_ns, self.config.delay_spike_max_ns
+            )
         return (PASS, latency)
 
     # -- sockets ------------------------------------------------------------

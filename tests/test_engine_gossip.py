@@ -1,0 +1,91 @@
+"""33-node quorum-broadcast machine (VERDICT r4 directive 6): the
+fixed-shape SoA design past the old 30-node group-mask cap — two-word
+masks, fanout-burst queue sizing, quorum invariant, and the
+duplicate-ack counting bug caught at the commit event."""
+
+import jax.numpy as jnp
+import pytest
+
+from madsim_tpu.engine import Engine, EngineConfig, FaultPlan, replay
+from madsim_tpu.engine.core import F_CLOG_GROUP
+from madsim_tpu.models.gossip import COMMIT_BELOW_QUORUM, GossipMachine
+
+FULL_VOCAB = FaultPlan(
+    n_faults=3,
+    allow_dir_clog=True,
+    allow_group=True,
+    allow_storm=True,
+    allow_delay=True,
+    t_max_us=3_000_000,
+    dur_min_us=200_000,
+    dur_max_us=700_000,
+)
+
+
+def _engine(machine=None, faults=FULL_VOCAB, queue=256):
+    return Engine(
+        machine or GossipMachine(num_nodes=33, rumors=6),
+        EngineConfig(horizon_us=5_000_000, queue_capacity=queue, faults=faults),
+    )
+
+
+def test_gossip_33_nodes_clean_under_full_vocabulary():
+    """Queue 256 absorbs the 33-node fanout bursts (measured: 5/192
+    overflows at 192, zero at 256 at the same seeds/s)."""
+    eng = _engine()
+    res = eng.make_runner(max_steps=9000)(jnp.arange(96, dtype=jnp.uint32))
+    codes = {int(c) for c in res.fail_code.tolist() if c}
+    assert not codes, codes
+    # real quorum work: most lanes commit all 6 rumors within horizon
+    assert int((res.summary["committed"] == 6).sum()) > 80
+
+
+def test_group_masks_past_30_nodes_split_both_sides():
+    """The lifted two-word mask: 33-node group faults draw masks with a
+    populated high word and the fault branch clogs exactly the
+    cross-group links (no silent 30-bit clamp)."""
+    from madsim_tpu.differential import fault_schedule
+
+    eng = _engine(faults=FaultPlan(
+        n_faults=3, allow_partition=False, allow_kill=False, allow_group=True,
+        t_max_us=3_000_000,
+    ))
+    hi_seen = 0
+    for seed in range(40):
+        for ev in fault_schedule(eng, seed):
+            if ev["op"] == F_CLOG_GROUP:
+                bits = [(ev["a"] >> i) & 1 for i in range(30)] + [
+                    (ev["b"] >> i) & 1 for i in range(3)
+                ]
+                n_in = sum(bits)
+                assert 1 <= n_in <= 32, "mask must split 33 nodes non-trivially"
+                if any(b for b in bits[30:]):
+                    hi_seen += 1
+    assert hi_seen > 0, "high-word mask bits (nodes 30-32) never drawn"
+
+
+def test_group_partitions_beyond_60_nodes_rejected_typed():
+    with pytest.raises(ValueError, match="two-word"):
+        _engine(machine=GossipMachine(num_nodes=61, rumors=4))
+
+
+def test_dup_ack_counting_bug_commits_below_quorum():
+    class Dup(GossipMachine):
+        DUP_ACK_COUNT = True
+
+    eng = _engine(Dup(num_nodes=33, rumors=6))
+    res = eng.make_runner(max_steps=9000)(jnp.arange(64, dtype=jnp.uint32))
+    codes = {int(c) for c in res.fail_code.tolist() if c}
+    assert codes == {COMMIT_BELOW_QUORUM}, codes
+    seed = int(eng.failing_seeds(res).tolist()[0])
+    rp = replay(eng, seed, max_steps=9000, trace=False)
+    assert rp.failed and rp.fail_code == COMMIT_BELOW_QUORUM
+
+
+def test_gossip_deterministic_same_seeds():
+    eng = _engine()
+    run = eng.make_runner(max_steps=9000)
+    r1 = run(jnp.arange(16, dtype=jnp.uint32))
+    r2 = run(jnp.arange(16, dtype=jnp.uint32))
+    assert r1.steps.tolist() == r2.steps.tolist()
+    assert r1.now_us.tolist() == r2.now_us.tolist()
