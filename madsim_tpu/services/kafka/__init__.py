@@ -153,13 +153,20 @@ class Broker:
     session-timeout eviction and generation-fenced commits; the
     reference sim has no groups at all)."""
 
-    def __init__(self, message_max_bytes: int = 1_000_000) -> None:
+    def __init__(self, message_max_bytes: int = 1_000_000,
+                 expire_on_traffic: bool = True) -> None:
         self.topics: Dict[str, List[Partition]] = {}
         self._rr: Dict[str, int] = {}
         self.message_max_bytes = message_max_bytes
         # (group, topic, partition) -> committed offset
         self.committed_offsets: Dict[Tuple[str, str, int], int] = {}
         self.groups: Dict[str, _Group] = {}
+        # True (default): member expiry sweeps on member traffic, like a
+        # coordinator checking sessions inline. False: expiry runs ONLY
+        # via sweep_expired() — the timer-driven coordinator model, used
+        # by the cross-engine differential to align eviction moments
+        # with the device machine's session tick exactly.
+        self.expire_on_traffic = expire_on_traffic
 
     def create_topic(self, name: str, partitions: int) -> None:
         if name in self.topics:
@@ -254,8 +261,11 @@ class Broker:
         if not group:
             raise KafkaError("group.id required to commit", ErrorCode.UNKNOWN_GROUP)
         if member_id is not None:
+            # timer-driven mode: commits validate but do NOT refresh the
+            # session (heartbeat-only liveness)
             self._coord_group(group, member_id, now_ms,
-                              generation, ErrorCode.ILLEGAL_GENERATION)
+                              generation, ErrorCode.ILLEGAL_GENERATION,
+                              refresh=self.expire_on_traffic)
         for (topic, partition), off in offsets.items():
             self._partition(topic, partition)  # validates
             self.committed_offsets[(group, topic, partition)] = off
@@ -315,6 +325,14 @@ class Broker:
         if dead:
             self._rebalance(g)
 
+    def sweep_expired(self, group: str, now_ms: int) -> None:
+        """Timer-driven expiry sweep: evict members whose sessions
+        lapsed and rebalance (the coordinator's periodic job; with
+        `expire_on_traffic=False` this is the ONLY eviction path)."""
+        g = self.groups.get(group)
+        if g is not None:
+            self._expire_members(g, now_ms)
+
     def join_group(
         self,
         group: str,
@@ -327,7 +345,8 @@ class Broker:
         if not group:
             raise KafkaError("group.id required to join", ErrorCode.UNKNOWN_GROUP)
         g = self.groups.setdefault(group, _Group())
-        self._expire_members(g, now_ms)
+        if self.expire_on_traffic:
+            self._expire_members(g, now_ms)
         if not g.members and strategy:
             g.strategy = strategy  # first joiner picks the strategy
         if member_id is None or member_id not in g.members:
@@ -359,7 +378,8 @@ class Broker:
         if member_id in g.members:
             del g.members[member_id]
             self._rebalance(g)
-        self._expire_members(g, now_ms)
+        if self.expire_on_traffic:
+            self._expire_members(g, now_ms)
 
     def describe_group(self, group: str, now_ms: int = 0) -> dict:
         g = self.groups.get(group)
@@ -368,7 +388,8 @@ class Broker:
         # reflect session-timeout semantics even when no member traffic
         # triggers eviction (a dead group would otherwise show its
         # corpse's assignments forever)
-        self._expire_members(g, now_ms)
+        if self.expire_on_traffic:
+            self._expire_members(g, now_ms)
         return {
             "generation": g.generation,
             "strategy": g.strategy,
@@ -383,13 +404,17 @@ class Broker:
         now_ms: int,
         generation: Optional[int] = None,
         stale_code: str = ErrorCode.REBALANCE_IN_PROGRESS,
+        refresh: bool = True,
     ) -> _Group:
         """Resolve + expire the group, validate the member, and (when
         `generation` is given) fence it — the single fencing path for
         sync/heartbeat/fenced-commit. A live check refreshes the
-        member's heartbeat clock."""
+        member's heartbeat clock — except commits in timer-driven mode
+        (`refresh=False`): there session liveness is heartbeat-only, so
+        an in-flight commit from a dying member cannot stretch its
+        session past what the heartbeat record supports."""
         g = self.groups.get(group)
-        if g is not None:
+        if g is not None and self.expire_on_traffic:
             self._expire_members(g, now_ms)
         if g is None or member_id not in g.members:
             raise KafkaError(f"unknown member: {member_id}", ErrorCode.UNKNOWN_MEMBER_ID)
@@ -397,7 +422,8 @@ class Broker:
             raise KafkaError(
                 f"generation {generation} != {g.generation}", stale_code
             )
-        g.members[member_id].last_hb_ms = now_ms
+        if refresh:
+            g.members[member_id].last_hb_ms = now_ms
         return g
 
 
