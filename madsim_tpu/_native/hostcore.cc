@@ -178,11 +178,32 @@ static PyObject* Rng_new(PyTypeObject* type, PyObject* args, PyObject* kwds) {
 
 static void Rng_dealloc(PyObject* self) {
   RngObject* r = reinterpret_cast<RngObject*>(self);
+  PyObject_GC_UnTrack(self);
   delete r->obs;
   r->obs = nullptr;
   Py_XDECREF(reinterpret_cast<PyObject*>(r->time_src));
   r->time_src = nullptr;
   Py_TYPE(self)->tp_free(self);
+}
+
+// GC support is load-bearing: bind_time gives the Rng a STRONG ref to
+// the TimeCore, closing a cycle through the whole runtime graph
+// (executor -> rng -> time_src -> TimeCore -> timer wakers -> tasks ->
+// executor). Without traverse/clear here that cycle is uncollectable,
+// and every simulation that ends with a task parked on a timer leaks
+// its entire runtime graph (~60 KB/seed, found round 5).
+static int Rng_traverse(PyObject* self, visitproc visit, void* arg) {
+  Py_VISIT(reinterpret_cast<PyObject*>(
+      reinterpret_cast<RngObject*>(self)->time_src));
+  return 0;
+}
+
+static int Rng_clear_gc(PyObject* self) {
+  RngObject* r = reinterpret_cast<RngObject*>(self);
+  PyObject* t = reinterpret_cast<PyObject*>(r->time_src);
+  r->time_src = nullptr;
+  Py_XDECREF(t);
+  return 0;
 }
 
 static PyObject* Rng_next_u32(PyObject* self, PyObject*) {
@@ -553,6 +574,12 @@ static PyObject* TaskWaker_call(PyObject* self, PyObject*, PyObject*) {
   Py_RETURN_NONE;
 }
 
+// fwd decls: native datagram wire/delivery moments (NetCore section)
+extern PyTypeObject PendingSendType;
+extern PyTypeObject PendingDeliverType;
+static int pending_send_fire(PyObject* ps_o);
+static int pending_deliver_fire(PyObject* pd_o);
+
 // Pop the earliest timer, jump the clock, fire the callback
 // (reference: sim/time/mod.rs:45-59). 1 = fired, 0 = empty, -1 = error.
 static int advance_next(TimeCoreObject* t) {
@@ -565,6 +592,10 @@ static int advance_next(TimeCoreObject* t) {
   if (Py_TYPE(e.cb) == &TaskWakerType) {
     // fast path: wake a task without a Python call
     if (taskwaker_fire(reinterpret_cast<TaskWakerObject*>(e.cb)) < 0) rc = -1;
+  } else if (Py_TYPE(e.cb) == &PendingSendType) {
+    if (pending_send_fire(e.cb) < 0) rc = -1;
+  } else if (Py_TYPE(e.cb) == &PendingDeliverType) {
+    if (pending_deliver_fire(e.cb) < 0) rc = -1;
   } else {
     PyObject* r = PyObject_CallNoArgs(e.cb);
     if (!r) rc = -1;
@@ -1050,6 +1081,112 @@ static PyMethodDef MailRecv_methods[] = {
     {nullptr, nullptr, 0, nullptr},
 };
 
+
+// ---------------------------------------------------------------------------
+// RecvDeadline — the RPC wait fused into ONE native pollable:
+// race(mailbox.recv(tag), sleep_until(deadline)). Ready(msg) on arrival,
+// Ready(None) on expiry — the Python caller maps None to TimeoutError.
+// Replaces timeout()'s coroutine + _InlineFuture + _Race + SleepGate
+// tower on the call_with_data hot path (net/rpc.py).
+// ---------------------------------------------------------------------------
+
+struct RecvDeadlineObject {
+  PyObject_HEAD
+  MailRecvObject* inner;  // strong; owns the mailbox registration
+  TimeCoreObject* core;   // strong
+  long long deadline_ns;
+  char armed;
+};
+
+static PyObject* RecvDeadline_new(PyTypeObject* type, PyObject* args,
+                                  PyObject*) {
+  PyObject *mb, *tag_o, *core;
+  long long deadline;
+  if (!PyArg_ParseTuple(args, "O!OLO!", &MailboxType, &mb, &tag_o, &deadline,
+                        &TimeCoreType, &core)) {
+    return nullptr;
+  }
+  PyObject* inner = Mailbox_recv(mb, tag_o);
+  if (!inner) return nullptr;
+  RecvDeadlineObject* self =
+      reinterpret_cast<RecvDeadlineObject*>(type->tp_alloc(type, 0));
+  if (!self) { Py_DECREF(inner); return nullptr; }
+  self->inner = reinterpret_cast<MailRecvObject*>(inner);
+  self->deadline_ns = deadline;
+  self->armed = 0;
+  Py_INCREF(core);
+  self->core = reinterpret_cast<TimeCoreObject*>(core);
+  return reinterpret_cast<PyObject*>(self);
+}
+
+static void RecvDeadline_dealloc(PyObject* self) {
+  RecvDeadlineObject* r = reinterpret_cast<RecvDeadlineObject*>(self);
+  PyObject_GC_UnTrack(self);
+  Py_XDECREF(reinterpret_cast<PyObject*>(r->inner));
+  Py_XDECREF(reinterpret_cast<PyObject*>(r->core));
+  Py_TYPE(self)->tp_free(self);
+}
+
+static int RecvDeadline_traverse(PyObject* self, visitproc visit, void* arg) {
+  RecvDeadlineObject* r = reinterpret_cast<RecvDeadlineObject*>(self);
+  Py_VISIT(reinterpret_cast<PyObject*>(r->inner));
+  Py_VISIT(reinterpret_cast<PyObject*>(r->core));
+  return 0;
+}
+
+static int RecvDeadline_clear_gc(PyObject* self) {
+  RecvDeadlineObject* r = reinterpret_cast<RecvDeadlineObject*>(self);
+  PyObject* i = reinterpret_cast<PyObject*>(r->inner); r->inner = nullptr;
+  Py_XDECREF(i);
+  PyObject* c = reinterpret_cast<PyObject*>(r->core); r->core = nullptr;
+  Py_XDECREF(c);
+  return 0;
+}
+
+static PyObject* RecvDeadline_poll(PyObject* self, PyObject* waker) {
+  RecvDeadlineObject* r = reinterpret_cast<RecvDeadlineObject*>(self);
+  // message first (the Python race polls inner before the deadline, so a
+  // response arriving exactly at the deadline still wins)
+  PyObject* got = MailRecv_poll(reinterpret_cast<PyObject*>(r->inner), waker);
+  if (!got) return nullptr;
+  if (got != g_pending) return got;  // Ready(msg)
+  Py_DECREF(got);
+  if (r->core->now_ns >= r->deadline_ns) {
+    // expiry: release the mailbox registration immediately (the Python
+    // race's drop-on-expiry semantics)
+    mailrecv_deregister(r->inner);
+    Py_INCREF(g_ready_none);
+    return g_ready_none;
+  }
+  if (!r->armed) {
+    r->armed = 1;
+    Py_INCREF(waker);
+    r->core->heap->push_back(
+        TimerEnt{r->deadline_ns, ++r->core->seq, waker});
+    std::push_heap(r->core->heap->begin(), r->core->heap->end(), TimerCmp{});
+  }
+  Py_INCREF(g_pending);
+  return g_pending;
+}
+
+static PyObject* RecvDeadline_drop(PyObject* self, PyObject*) {
+  RecvDeadlineObject* r = reinterpret_cast<RecvDeadlineObject*>(self);
+  if (r->inner && !r->inner->done) mailrecv_deregister(r->inner);
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef RecvDeadline_methods[] = {
+    {"poll", RecvDeadline_poll, METH_O, "Pollable.poll(waker)"},
+    {"drop", RecvDeadline_drop, METH_NOARGS, "cancellation safety"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PyTypeObject RecvDeadlineType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "hostcore.RecvDeadline",      /* tp_name */
+    sizeof(RecvDeadlineObject),   /* tp_basicsize */
+};
+
 // ---------------------------------------------------------------------------
 // SleepGate — the sleep pollable with a native poll
 // (semantics of time.SleepFuture: registers a timer-wake on each poll)
@@ -1137,6 +1274,652 @@ static PyTypeObject SleepGateType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
     "hostcore.SleepGate",      /* tp_name */
     sizeof(SleepGateObject),   /* tp_basicsize */
+};
+
+
+// ---------------------------------------------------------------------------
+// NetCore — the datagram send/wire/delivery hot path in C
+// (mirrors net/__init__.py send_raw -> _send_phase2 -> network.try_send;
+// reference: sim/net/mod.rs:287-334 + network.rs:261-325).
+//
+// Python stays the source of truth for all STATE (clog sets, socket
+// tables, config, hooks, ipvs, incarnations live in the NetSim/Network
+// objects; NetCore holds references and reads them at the wire moment),
+// and any feature the fast path does not model — drop hooks on RPC
+// traffic, IPVS rewrites — falls back to the Python _send_phase2 at
+// fire time. Draw order is bit-identical to the Python path (buggify
+// gate, 0-5 us delay, loss gate only when rate > 0, latency), so the
+// cross-path parity tests keep holding.
+// ---------------------------------------------------------------------------
+
+static PyObject* s_buggify_enabled;
+static PyObject* s_send_phase2;
+static PyObject* s_deliver_m;
+static PyObject* s_executor;
+static PyObject* s_msg_count;
+static PyObject* s_packet_loss_rate;
+static PyObject* s_lat_min;
+static PyObject* s_lat_max;
+static PyObject* s_spike_prob;
+static PyObject* s_spike_min;
+static PyObject* s_spike_max;
+static PyObject* g_ip_loopback = nullptr;  // "127.0.0.1"
+static PyObject* g_ip_zero = nullptr;      // "0.0.0.0"
+static PyObject* g_rpc_req_str = nullptr;  // "rpc_req"
+
+struct NetCoreObject {
+  PyObject_HEAD
+  PyObject* netsim;
+  PyObject* rng_wrap;      // GlobalRng (buggify_enabled lives here)
+  RngObject* rng;          // native draw stream (strong)
+  TimeCoreObject* timec;   // native timer heap (strong)
+  PyObject* msg_cls;       // net.endpoint.Message
+  PyObject* ctx_current;   // _context.current (panic routing)
+  PyObject* cfg;           // network.config (NetConfig; storms mutate it)
+  PyObject* hooks_req;     // netsim._hooks_req (list)
+  PyObject* hooks_rsp;     // netsim._hooks_rsp (list)
+  PyObject* ipvs_services; // netsim.ipvs._services (dict)
+  PyObject* incarnation;   // netsim._incarnation (dict)
+  PyObject* clogged_in;    // network.clogged_in (set)
+  PyObject* clogged_out;   // network.clogged_out (set)
+  PyObject* clogged_links; // network.clogged_links (set of (src, dst))
+  PyObject* sockets;       // network.sockets (dict node -> {port: sock})
+  PyObject* ip_node;       // network.ip_node (dict ip -> node)
+  PyObject* node_ip;       // network.node_ip (dict node -> ip)
+  PyObject* stat;          // network.stat
+  uint64_t send_seq;       // every-16th blocking-send cadence
+};
+
+struct PendingSendObject {
+  PyObject_HEAD
+  NetCoreObject* nc;   // strong
+  long src_node;
+  long incarnation;
+  PyObject* src_addr;  // (ip, port)
+  PyObject* dst;       // sender-visible destination (hooks see this)
+  PyObject* resolved;  // post-DNS destination
+  PyObject* tag;
+  PyObject* payload;
+  PyObject* kind;      // None | "rpc_req" | "rpc_rsp"
+};
+
+struct PendingDeliverObject {
+  PyObject_HEAD
+  PyObject* sock;
+  PyObject* msg;
+};
+
+static void PendingSend_dealloc(PyObject* self) {
+  PendingSendObject* p = reinterpret_cast<PendingSendObject*>(self);
+  PyObject_GC_UnTrack(self);
+  Py_XDECREF(reinterpret_cast<PyObject*>(p->nc));
+  Py_XDECREF(p->src_addr);
+  Py_XDECREF(p->dst);
+  Py_XDECREF(p->resolved);
+  Py_XDECREF(p->tag);
+  Py_XDECREF(p->payload);
+  Py_XDECREF(p->kind);
+  Py_TYPE(self)->tp_free(self);
+}
+
+static int PendingSend_traverse(PyObject* self, visitproc visit, void* arg) {
+  PendingSendObject* p = reinterpret_cast<PendingSendObject*>(self);
+  Py_VISIT(reinterpret_cast<PyObject*>(p->nc));
+  Py_VISIT(p->src_addr);
+  Py_VISIT(p->dst);
+  Py_VISIT(p->resolved);
+  Py_VISIT(p->tag);
+  Py_VISIT(p->payload);
+  Py_VISIT(p->kind);
+  return 0;
+}
+
+static void PendingDeliver_dealloc(PyObject* self) {
+  PendingDeliverObject* p = reinterpret_cast<PendingDeliverObject*>(self);
+  PyObject_GC_UnTrack(self);
+  Py_XDECREF(p->sock);
+  Py_XDECREF(p->msg);
+  Py_TYPE(self)->tp_free(self);
+}
+
+static int PendingDeliver_traverse(PyObject* self, visitproc visit, void* arg) {
+  PendingDeliverObject* p = reinterpret_cast<PendingDeliverObject*>(self);
+  Py_VISIT(p->sock);
+  Py_VISIT(p->msg);
+  return 0;
+}
+
+static void NetCore_dealloc(PyObject* self) {
+  NetCoreObject* n = reinterpret_cast<NetCoreObject*>(self);
+  PyObject_GC_UnTrack(self);
+  Py_XDECREF(n->netsim);
+  Py_XDECREF(n->rng_wrap);
+  Py_XDECREF(reinterpret_cast<PyObject*>(n->rng));
+  Py_XDECREF(reinterpret_cast<PyObject*>(n->timec));
+  Py_XDECREF(n->msg_cls);
+  Py_XDECREF(n->ctx_current);
+  Py_XDECREF(n->cfg);
+  Py_XDECREF(n->hooks_req);
+  Py_XDECREF(n->hooks_rsp);
+  Py_XDECREF(n->ipvs_services);
+  Py_XDECREF(n->incarnation);
+  Py_XDECREF(n->clogged_in);
+  Py_XDECREF(n->clogged_out);
+  Py_XDECREF(n->clogged_links);
+  Py_XDECREF(n->sockets);
+  Py_XDECREF(n->ip_node);
+  Py_XDECREF(n->node_ip);
+  Py_XDECREF(n->stat);
+  Py_TYPE(self)->tp_free(self);
+}
+
+static int NetCore_traverse(PyObject* self, visitproc visit, void* arg) {
+  NetCoreObject* n = reinterpret_cast<NetCoreObject*>(self);
+  Py_VISIT(n->netsim);
+  Py_VISIT(n->rng_wrap);
+  Py_VISIT(reinterpret_cast<PyObject*>(n->rng));
+  Py_VISIT(reinterpret_cast<PyObject*>(n->timec));
+  Py_VISIT(n->msg_cls);
+  Py_VISIT(n->ctx_current);
+  Py_VISIT(n->cfg);
+  Py_VISIT(n->hooks_req);
+  Py_VISIT(n->hooks_rsp);
+  Py_VISIT(n->ipvs_services);
+  Py_VISIT(n->incarnation);
+  Py_VISIT(n->clogged_in);
+  Py_VISIT(n->clogged_out);
+  Py_VISIT(n->clogged_links);
+  Py_VISIT(n->sockets);
+  Py_VISIT(n->ip_node);
+  Py_VISIT(n->node_ip);
+  Py_VISIT(n->stat);
+  return 0;
+}
+
+static int NetCore_clear_gc(PyObject* self) {
+  NetCoreObject* n = reinterpret_cast<NetCoreObject*>(self);
+  Py_CLEAR(n->netsim);
+  Py_CLEAR(n->rng_wrap);
+  PyObject* r = reinterpret_cast<PyObject*>(n->rng); n->rng = nullptr; Py_XDECREF(r);
+  PyObject* t = reinterpret_cast<PyObject*>(n->timec); n->timec = nullptr; Py_XDECREF(t);
+  Py_CLEAR(n->msg_cls);
+  Py_CLEAR(n->ctx_current);
+  Py_CLEAR(n->cfg);
+  Py_CLEAR(n->hooks_req);
+  Py_CLEAR(n->hooks_rsp);
+  Py_CLEAR(n->ipvs_services);
+  Py_CLEAR(n->incarnation);
+  Py_CLEAR(n->clogged_in);
+  Py_CLEAR(n->clogged_out);
+  Py_CLEAR(n->clogged_links);
+  Py_CLEAR(n->sockets);
+  Py_CLEAR(n->ip_node);
+  Py_CLEAR(n->node_ip);
+  Py_CLEAR(n->stat);
+  return 0;
+}
+
+// NetCore(netsim, network, rng_wrap, rng_core, time_core, msg_cls, ctx_current)
+static PyObject* NetCore_new(PyTypeObject* type, PyObject* args, PyObject*) {
+  PyObject *netsim, *network, *rng_wrap, *rng_o, *time_o, *msg_cls, *ctx_cur;
+  if (!PyArg_ParseTuple(args, "OOOO!O!OO", &netsim, &network, &rng_wrap,
+                        &RngType, &rng_o, &TimeCoreType, &time_o, &msg_cls,
+                        &ctx_cur)) {
+    return nullptr;
+  }
+  NetCoreObject* self = reinterpret_cast<NetCoreObject*>(type->tp_alloc(type, 0));
+  if (!self) return nullptr;
+  self->send_seq = 0;
+  Py_INCREF(netsim); self->netsim = netsim;
+  Py_INCREF(rng_wrap); self->rng_wrap = rng_wrap;
+  Py_INCREF(rng_o); self->rng = reinterpret_cast<RngObject*>(rng_o);
+  Py_INCREF(time_o); self->timec = reinterpret_cast<TimeCoreObject*>(time_o);
+  Py_INCREF(msg_cls); self->msg_cls = msg_cls;
+  Py_INCREF(ctx_cur); self->ctx_current = ctx_cur;
+#define PULL(dst, src, name)                                    \
+  self->dst = PyObject_GetAttrString(src, name);                \
+  if (!self->dst) { Py_DECREF(self); return nullptr; }
+  PULL(cfg, network, "config")
+  PULL(hooks_req, netsim, "_hooks_req")
+  PULL(hooks_rsp, netsim, "_hooks_rsp")
+  PULL(incarnation, netsim, "_incarnation")
+  PULL(clogged_in, network, "clogged_in")
+  PULL(clogged_out, network, "clogged_out")
+  PULL(clogged_links, network, "clogged_links")
+  PULL(sockets, network, "sockets")
+  PULL(ip_node, network, "ip_node")
+  PULL(node_ip, network, "node_ip")
+  PULL(stat, network, "stat")
+#undef PULL
+  PyObject* ipvs = PyObject_GetAttrString(netsim, "ipvs");
+  if (!ipvs) { Py_DECREF(self); return nullptr; }
+  self->ipvs_services = PyObject_GetAttrString(ipvs, "_services");
+  Py_DECREF(ipvs);
+  if (!self->ipvs_services) { Py_DECREF(self); return nullptr; }
+  return reinterpret_cast<PyObject*>(self);
+}
+
+static inline double rng_random_f64(RngObject* r) {
+  return static_cast<double>(rng_u64(r) >> 11) * (1.0 / 9007199254740992.0);
+}
+
+// send(src_node, src_addr, dst, resolved, tag, payload, kind)
+//   -> None            datagram scheduled natively (timer at t + delay)
+//   -> (1, delay_ns)   buggified 1-5 s: caller awaits then runs phase2
+//   -> (2, delay_ns)   every-16th blocking send: caller awaits then phase2
+static PyObject* NetCore_send(PyObject* self, PyObject* args) {
+  NetCoreObject* nc = reinterpret_cast<NetCoreObject*>(self);
+  long src_node;
+  PyObject *src_addr, *dst, *resolved, *tag, *payload, *kind;
+  if (!PyArg_ParseTuple(args, "lOOOOOO", &src_node, &src_addr, &dst,
+                        &resolved, &tag, &payload, &kind)) {
+    return nullptr;
+  }
+  // buggify gate (rand/__init__.py buggify_with_prob: no draw when off)
+  PyObject* bug = PyObject_GetAttr(nc->rng_wrap, s_buggify_enabled);
+  if (!bug) return nullptr;
+  int buggify = PyObject_IsTrue(bug);
+  Py_DECREF(bug);
+  if (buggify < 0) return nullptr;
+  if (buggify && rng_random_f64(nc->rng) < 0.1) {
+    int64_t big = rng_range(nc->rng, 1000000000LL, 5000000000LL);
+    return Py_BuildValue("(iL)", 1, static_cast<long long>(big));
+  }
+  int64_t delay = rng_range(nc->rng, 0, 5000);
+  if (++nc->send_seq % 16 == 0) {
+    return Py_BuildValue("(iL)", 2, static_cast<long long>(delay));
+  }
+  long inc = 0;
+  {
+    PyObject* k = PyLong_FromLong(src_node);
+    if (!k) return nullptr;
+    PyObject* v = PyDict_GetItemWithError(nc->incarnation, k);  // borrowed
+    Py_DECREF(k);
+    if (!v && PyErr_Occurred()) return nullptr;
+    if (v) {
+      inc = PyLong_AsLong(v);
+      if (inc == -1 && PyErr_Occurred()) return nullptr;
+    }
+  }
+  PendingSendObject* ps = PyObject_GC_New(PendingSendObject, &PendingSendType);
+  if (!ps) return nullptr;
+  Py_INCREF(self); ps->nc = nc;
+  ps->src_node = src_node;
+  ps->incarnation = inc;
+  Py_INCREF(src_addr); ps->src_addr = src_addr;
+  Py_INCREF(dst); ps->dst = dst;
+  Py_INCREF(resolved); ps->resolved = resolved;
+  Py_INCREF(tag); ps->tag = tag;
+  Py_INCREF(payload); ps->payload = payload;
+  Py_INCREF(kind); ps->kind = kind;
+  PyObject_GC_Track(reinterpret_cast<PyObject*>(ps));
+  TimeCoreObject* t = nc->timec;
+  // the heap takes ownership of ps (no extra incref: we hand our ref over)
+  t->heap->push_back(TimerEnt{t->now_ns + delay, ++t->seq,
+                              reinterpret_cast<PyObject*>(ps)});
+  std::push_heap(t->heap->begin(), t->heap->end(), TimerCmp{});
+  Py_RETURN_NONE;
+}
+
+// Exception during a wire/delivery moment: route to executor.panic — the
+// loud-failure path _send_phase2_guarded uses (net/__init__.py).
+static int route_panic(NetCoreObject* nc) {
+  PyObject *etype, *evalue, *etb;
+  PyErr_Fetch(&etype, &evalue, &etb);
+  PyErr_NormalizeException(&etype, &evalue, &etb);
+  if (etb) PyException_SetTraceback(evalue, etb);
+  int ok = -1;
+  PyObject* ctx = PyObject_CallNoArgs(nc->ctx_current);
+  if (ctx) {
+    PyObject* ex = PyObject_GetAttr(ctx, s_executor);
+    Py_DECREF(ctx);
+    if (ex) {
+      if (PyObject_SetAttr(ex, s_panic, evalue) == 0) ok = 0;
+      Py_DECREF(ex);
+    }
+  }
+  if (ok < 0) {
+    PyErr_Restore(etype, evalue, etb);
+    return -1;
+  }
+  Py_XDECREF(etype);
+  Py_XDECREF(evalue);
+  Py_XDECREF(etb);
+  return 0;
+}
+
+static int pending_send_fire(PyObject* ps_o) {
+  PendingSendObject* ps = reinterpret_cast<PendingSendObject*>(ps_o);
+  NetCoreObject* nc = ps->nc;
+
+  // sender died between send and wire moment: drop (kill cancels the
+  // suspended sender in the reference; see net/__init__.py)
+  {
+    PyObject* k = PyLong_FromLong(ps->src_node);
+    if (!k) return route_panic(nc);
+    PyObject* v = PyDict_GetItemWithError(nc->incarnation, k);
+    Py_DECREF(k);
+    if (!v && PyErr_Occurred()) return route_panic(nc);
+    long cur = 0;
+    if (v) {
+      cur = PyLong_AsLong(v);
+      if (cur == -1 && PyErr_Occurred()) return route_panic(nc);
+    }
+    if (cur != ps->incarnation) return 0;
+  }
+
+  // features the fast path does not model: RPC drop hooks, IPVS
+  // rewrites -> Python _send_phase2 handles the whole wire moment
+  int fallback = PyDict_Size(nc->ipvs_services) > 0;
+  if (!fallback && ps->kind != Py_None) {
+    int is_req = PyUnicode_CompareWithASCIIString(ps->kind, "rpc_req") == 0;
+    PyObject* lst = is_req ? nc->hooks_req : nc->hooks_rsp;
+    if (PyList_Check(lst) && PyList_GET_SIZE(lst) > 0) fallback = 1;
+  }
+  if (fallback) {
+    PyObject* src_l = PyLong_FromLong(ps->src_node);
+    if (!src_l) return route_panic(nc);
+    PyObject* r = PyObject_CallMethodObjArgs(
+        nc->netsim, s_send_phase2, src_l, ps->src_addr, ps->dst, ps->resolved,
+        ps->tag, ps->payload, ps->kind, nullptr);
+    Py_DECREF(src_l);
+    if (!r) return route_panic(nc);
+    Py_DECREF(r);
+    return 0;
+  }
+
+  // ---- network.try_send, natively ----------------------------------------
+  PyObject* res_ip = PyTuple_GetItem(ps->resolved, 0);   // borrowed
+  PyObject* res_port = PyTuple_GetItem(ps->resolved, 1); // borrowed
+  if (!res_ip || !res_port) return route_panic(nc);
+  const char* ip = PyUnicode_AsUTF8(res_ip);
+  if (!ip) return route_panic(nc);
+  int loop = strncmp(ip, "127.", 4) == 0 || strcmp(ip, "localhost") == 0;
+  long dst_node;
+  if (loop) {
+    dst_node = ps->src_node;
+  } else {
+    PyObject* dn = PyDict_GetItemWithError(nc->ip_node, res_ip);
+    if (!dn) return PyErr_Occurred() ? route_panic(nc) : 0;  // no such ip: drop
+    dst_node = PyLong_AsLong(dn);
+    if (dst_node == -1 && PyErr_Occurred()) return route_panic(nc);
+  }
+  PyObject* dst_l = PyLong_FromLong(dst_node);
+  if (!dst_l) return route_panic(nc);
+  PyObject* socks = PyDict_GetItemWithError(nc->sockets, dst_l);  // borrowed
+  if (!socks) {
+    Py_DECREF(dst_l);
+    return PyErr_Occurred() ? route_panic(nc) : 0;  // node gone: drop
+  }
+  PyObject* sock = PyDict_GetItemWithError(socks, res_port);  // borrowed
+  if (!sock) {
+    Py_DECREF(dst_l);
+    return PyErr_Occurred() ? route_panic(nc) : 0;  // nothing bound: drop
+  }
+  Py_INCREF(sock);
+
+  // clog check (network.is_clogged)
+  PyObject* src_l = PyLong_FromLong(ps->src_node);
+  if (!src_l) { Py_DECREF(sock); Py_DECREF(dst_l); return route_panic(nc); }
+  int clogged = PySet_Contains(nc->clogged_out, src_l);
+  if (clogged == 0) {
+    int c2 = PySet_Contains(nc->clogged_in, dst_l);
+    clogged = c2 != 0 ? c2 : 0;
+    if (clogged == 0) {
+      PyObject* pair = PyTuple_Pack(2, src_l, dst_l);
+      if (!pair) clogged = -1;
+      else {
+        clogged = PySet_Contains(nc->clogged_links, pair);
+        Py_DECREF(pair);
+      }
+    }
+  }
+  Py_DECREF(src_l);
+  Py_DECREF(dst_l);
+  if (clogged < 0) { Py_DECREF(sock); return route_panic(nc); }
+  if (clogged) { Py_DECREF(sock); return 0; }
+
+  // loss gate: draw only when the (live, storm-composited) rate > 0
+  PyObject* lr = PyObject_GetAttr(nc->cfg, s_packet_loss_rate);
+  if (!lr) { Py_DECREF(sock); return route_panic(nc); }
+  double rate = PyFloat_AsDouble(lr);
+  Py_DECREF(lr);
+  if (rate == -1.0 && PyErr_Occurred()) { Py_DECREF(sock); return route_panic(nc); }
+  if (rate > 0.0 && rng_random_f64(nc->rng) < rate) { Py_DECREF(sock); return 0; }
+
+  // latency draw (network.test_link)
+  PyObject* lmin_o = PyObject_GetAttr(nc->cfg, s_lat_min);
+  PyObject* lmax_o = lmin_o ? PyObject_GetAttr(nc->cfg, s_lat_max) : nullptr;
+  if (!lmin_o || !lmax_o) {
+    Py_XDECREF(lmin_o); Py_XDECREF(lmax_o); Py_DECREF(sock);
+    return route_panic(nc);
+  }
+  long long lmin = PyLong_AsLongLong(lmin_o);
+  long long lmax = PyLong_AsLongLong(lmax_o);
+  Py_DECREF(lmin_o);
+  Py_DECREF(lmax_o);
+  if ((lmin == -1 || lmax == -1) && PyErr_Occurred()) {
+    Py_DECREF(sock);
+    return route_panic(nc);
+  }
+  int64_t latency = rng_range(nc->rng, lmin, lmax + 1);
+
+  // delay-spike window (network.py test_link lines ~171-177): same
+  // draws in the same order as the Python path — parity requires the
+  // gen_bool draw whenever the prob is nonzero
+  {
+    PyObject* sp = PyObject_GetAttr(nc->cfg, s_spike_prob);
+    if (!sp) { Py_DECREF(sock); return route_panic(nc); }
+    double spike_prob = PyFloat_AsDouble(sp);
+    Py_DECREF(sp);
+    if (spike_prob == -1.0 && PyErr_Occurred()) {
+      Py_DECREF(sock);
+      return route_panic(nc);
+    }
+    if (spike_prob > 0.0 && rng_random_f64(nc->rng) < spike_prob) {
+      PyObject* smin_o = PyObject_GetAttr(nc->cfg, s_spike_min);
+      PyObject* smax_o = smin_o ? PyObject_GetAttr(nc->cfg, s_spike_max) : nullptr;
+      if (!smin_o || !smax_o) {
+        Py_XDECREF(smin_o); Py_XDECREF(smax_o); Py_DECREF(sock);
+        return route_panic(nc);
+      }
+      long long smin = PyLong_AsLongLong(smin_o);
+      long long smax = PyLong_AsLongLong(smax_o);
+      Py_DECREF(smin_o);
+      Py_DECREF(smax_o);
+      if ((smin == -1 || smax == -1) && PyErr_Occurred()) {
+        Py_DECREF(sock);
+        return route_panic(nc);
+      }
+      latency += rng_range(nc->rng, smin, smax);
+    }
+  }
+
+  // stats
+  {
+    PyObject* cnt = PyObject_GetAttr(nc->stat, s_msg_count);
+    if (!cnt) { Py_DECREF(sock); return route_panic(nc); }
+    PyObject* one = PyLong_FromLong(1);
+    PyObject* ncnt = one ? PyNumber_Add(cnt, one) : nullptr;
+    Py_DECREF(cnt);
+    Py_XDECREF(one);
+    int st = ncnt ? PyObject_SetAttr(nc->stat, s_msg_count, ncnt) : -1;
+    Py_XDECREF(ncnt);
+    if (st < 0) { Py_DECREF(sock); return route_panic(nc); }
+  }
+
+  // source address the peer observes (NetSim._src_ip)
+  PyObject* fip;
+  if (loop) {
+    fip = g_ip_loopback;
+    Py_INCREF(fip);
+  } else {
+    PyObject* k = PyLong_FromLong(ps->src_node);
+    if (!k) { Py_DECREF(sock); return route_panic(nc); }
+    PyObject* v = PyDict_GetItemWithError(nc->node_ip, k);
+    Py_DECREF(k);
+    if (!v && PyErr_Occurred()) { Py_DECREF(sock); return route_panic(nc); }
+    fip = v ? v : g_ip_zero;
+    Py_INCREF(fip);
+  }
+  PyObject* src_port = PyTuple_GetItem(ps->src_addr, 1);  // borrowed
+  if (!src_port) { Py_DECREF(fip); Py_DECREF(sock); return route_panic(nc); }
+  PyObject* from_addr = PyTuple_Pack(2, fip, src_port);
+  Py_DECREF(fip);
+  if (!from_addr) { Py_DECREF(sock); return route_panic(nc); }
+  PyObject* msg = PyObject_CallFunctionObjArgs(
+      nc->msg_cls, ps->tag, ps->payload, from_addr, nullptr);
+  Py_DECREF(from_addr);
+  if (!msg) { Py_DECREF(sock); return route_panic(nc); }
+
+  PendingDeliverObject* pd =
+      PyObject_GC_New(PendingDeliverObject, &PendingDeliverType);
+  if (!pd) { Py_DECREF(msg); Py_DECREF(sock); return route_panic(nc); }
+  pd->sock = sock;  // both refs handed over
+  pd->msg = msg;
+  PyObject_GC_Track(reinterpret_cast<PyObject*>(pd));
+  TimeCoreObject* t = nc->timec;
+  t->heap->push_back(TimerEnt{t->now_ns + latency, ++t->seq,
+                              reinterpret_cast<PyObject*>(pd)});
+  std::push_heap(t->heap->begin(), t->heap->end(), TimerCmp{});
+  return 0;
+}
+
+static int pending_deliver_fire(PyObject* pd_o) {
+  PendingDeliverObject* pd = reinterpret_cast<PendingDeliverObject*>(pd_o);
+  PyObject* r = PyObject_CallMethodObjArgs(pd->sock, s_deliver_m, pd->msg,
+                                           nullptr);
+  if (!r) return -1;  // propagate, like a raising Python timer callback
+  Py_DECREF(r);
+  return 0;
+}
+
+
+// rpc_call(mailbox, src_node, src_addr, dst, resolved, type_id, req,
+//          data, deadline_ns)
+//   -> (wait, None)               request scheduled; await `wait`
+//   -> (wait, (mode, delay_ns, payload))  blocking-send case: the caller
+//      awaits the delay, runs _send_phase2 with `payload`, then awaits
+//      `wait`. Draw order matches the Python path exactly: rsp-tag u64,
+//      then the send draws.
+static PyObject* NetCore_rpc_call(PyObject* self, PyObject* args) {
+  NetCoreObject* nc = reinterpret_cast<NetCoreObject*>(self);
+  PyObject *mb, *src_addr, *dst, *resolved, *type_id, *req, *data;
+  long src_node;
+  long long deadline_ns;
+  if (!PyArg_ParseTuple(args, "O!lOOOOOOL", &MailboxType, &mb, &src_node,
+                        &src_addr, &dst, &resolved, &type_id, &req, &data,
+                        &deadline_ns)) {
+    return nullptr;
+  }
+  // response tag: the same draw call_with_data makes (thread_rng().next_u64())
+  uint64_t rsp_tag = rng_u64(nc->rng);
+  PyObject* tag_o = PyLong_FromUnsignedLongLong(rsp_tag);
+  if (!tag_o) return nullptr;
+  PyObject* payload = PyTuple_Pack(3, tag_o, req, data);
+  if (!payload) { Py_DECREF(tag_o); return nullptr; }
+
+  // register the receiver BEFORE the send (equivalent: the response
+  // cannot arrive before the request leaves the wire moment)
+  PyObject* wait_args = Py_BuildValue(
+      "(OOLO)", mb, tag_o, deadline_ns,
+      reinterpret_cast<PyObject*>(nc->timec));
+  Py_DECREF(tag_o);
+  if (!wait_args) { Py_DECREF(payload); return nullptr; }
+  PyObject* wait = PyObject_CallObject(
+      reinterpret_cast<PyObject*>(&RecvDeadlineType), wait_args);
+  Py_DECREF(wait_args);
+  if (!wait) { Py_DECREF(payload); return nullptr; }
+
+  // ---- the send (same draws/cadence as NetCore_send) ----------------------
+  PyObject* bug = PyObject_GetAttr(nc->rng_wrap, s_buggify_enabled);
+  if (!bug) { Py_DECREF(wait); Py_DECREF(payload); return nullptr; }
+  int buggify = PyObject_IsTrue(bug);
+  Py_DECREF(bug);
+  if (buggify < 0) { Py_DECREF(wait); Py_DECREF(payload); return nullptr; }
+  long long blocking = -1;
+  int mode = 0;
+  if (buggify && rng_random_f64(nc->rng) < 0.1) {
+    blocking = rng_range(nc->rng, 1000000000LL, 5000000000LL);
+    mode = 1;
+  } else {
+    long long delay = rng_range(nc->rng, 0, 5000);
+    if (++nc->send_seq % 16 == 0) {
+      blocking = delay;
+      mode = 2;
+    } else {
+      long inc = 0;
+      {
+        PyObject* k = PyLong_FromLong(src_node);
+        if (!k) { Py_DECREF(wait); Py_DECREF(payload); return nullptr; }
+        PyObject* v = PyDict_GetItemWithError(nc->incarnation, k);
+        Py_DECREF(k);
+        if (!v && PyErr_Occurred()) {
+          Py_DECREF(wait); Py_DECREF(payload);
+          return nullptr;
+        }
+        if (v) inc = PyLong_AsLong(v);
+      }
+      PendingSendObject* ps =
+          PyObject_GC_New(PendingSendObject, &PendingSendType);
+      if (!ps) { Py_DECREF(wait); Py_DECREF(payload); return nullptr; }
+      Py_INCREF(self); ps->nc = nc;
+      ps->src_node = src_node;
+      ps->incarnation = inc;
+      Py_INCREF(src_addr); ps->src_addr = src_addr;
+      Py_INCREF(dst); ps->dst = dst;
+      Py_INCREF(resolved); ps->resolved = resolved;
+      Py_INCREF(type_id); ps->tag = type_id;
+      ps->payload = payload;  // hand over our ref
+      payload = nullptr;
+      Py_INCREF(g_rpc_req_str);
+      ps->kind = g_rpc_req_str;
+      PyObject_GC_Track(reinterpret_cast<PyObject*>(ps));
+      TimeCoreObject* t = nc->timec;
+      t->heap->push_back(TimerEnt{t->now_ns + delay, ++t->seq,
+                                  reinterpret_cast<PyObject*>(ps)});
+      std::push_heap(t->heap->begin(), t->heap->end(), TimerCmp{});
+    }
+  }
+  PyObject* out;
+  if (mode == 0) {
+    out = PyTuple_Pack(2, wait, Py_None);
+  } else {
+    PyObject* blk = Py_BuildValue("(iLO)", mode, blocking, payload);
+    out = blk ? PyTuple_Pack(2, wait, blk) : nullptr;
+    Py_XDECREF(blk);
+  }
+  Py_XDECREF(payload);
+  Py_DECREF(wait);
+  return out;
+}
+
+static PyMethodDef NetCore_methods[] = {
+    {"rpc_call", NetCore_rpc_call, METH_VARARGS,
+     "fused RPC initiation: tag draw + recv-with-deadline registration + "
+     "native send"},
+    {"send", NetCore_send, METH_VARARGS,
+     "native datagram send; None = scheduled, (mode, delay_ns) = caller "
+     "must await the blocking path"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PyTypeObject NetCoreType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "hostcore.NetCore",        /* tp_name */
+    sizeof(NetCoreObject),     /* tp_basicsize */
+};
+
+PyTypeObject PendingSendType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "hostcore.PendingSend",    /* tp_name */
+    sizeof(PendingSendObject), /* tp_basicsize */
+};
+
+PyTypeObject PendingDeliverType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "hostcore.PendingDeliver",    /* tp_name */
+    sizeof(PendingDeliverObject), /* tp_basicsize */
 };
 
 // ---------------------------------------------------------------------------
@@ -1443,9 +2226,11 @@ static struct PyModuleDef hostcore_module = {
 }  // namespace
 
 PyMODINIT_FUNC PyInit_hostcore(void) {
-  RngType.tp_flags = Py_TPFLAGS_DEFAULT;
+  RngType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
   RngType.tp_new = Rng_new;
   RngType.tp_dealloc = Rng_dealloc;
+  RngType.tp_traverse = Rng_traverse;
+  RngType.tp_clear = Rng_clear_gc;
   RngType.tp_methods = Rng_methods;
   RngType.tp_doc = "buffered Philox4x32-10 draw stream";
   if (PyType_Ready(&RngType) < 0) return nullptr;
@@ -1470,6 +2255,11 @@ PyMODINIT_FUNC PyInit_hostcore(void) {
   if (PyType_Ready(&TaskWakerType) < 0) return nullptr;
 
   AwaitIterType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  // am_await = self: `await await_(p)` can use the AwaitIter DIRECTLY
+  // as the awaitable, skipping the Python _Await wrapper per await
+  static PyAsyncMethods await_iter_async = {PyObject_SelfIter, nullptr,
+                                            nullptr, nullptr};
+  AwaitIterType.tp_as_async = &await_iter_async;
   AwaitIterType.tp_new = AwaitIter_new;
   AwaitIterType.tp_dealloc = AwaitIter_dealloc;
   AwaitIterType.tp_traverse = AwaitIter_traverse;
@@ -1507,6 +2297,41 @@ PyMODINIT_FUNC PyInit_hostcore(void) {
   MailRecvType.tp_doc = "pending tag receive (Pollable)";
   if (PyType_Ready(&MailRecvType) < 0) return nullptr;
 
+  RecvDeadlineType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  RecvDeadlineType.tp_new = RecvDeadline_new;
+  RecvDeadlineType.tp_dealloc = RecvDeadline_dealloc;
+  RecvDeadlineType.tp_traverse = RecvDeadline_traverse;
+  RecvDeadlineType.tp_clear = RecvDeadline_clear_gc;
+  RecvDeadlineType.tp_methods = RecvDeadline_methods;
+  RecvDeadlineType.tp_doc = "fused recv-with-deadline pollable (RPC wait)";
+  if (PyType_Ready(&RecvDeadlineType) < 0) return nullptr;
+
+  NetCoreType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  NetCoreType.tp_new = NetCore_new;
+  NetCoreType.tp_dealloc = NetCore_dealloc;
+  NetCoreType.tp_traverse = NetCore_traverse;
+  NetCoreType.tp_clear = NetCore_clear_gc;
+  NetCoreType.tp_methods = NetCore_methods;
+  NetCoreType.tp_doc = "native datagram send/wire/delivery hot path";
+  if (PyType_Ready(&NetCoreType) < 0) return nullptr;
+
+  PendingSendType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  PendingSendType.tp_dealloc = PendingSend_dealloc;
+  PendingSendType.tp_traverse = PendingSend_traverse;
+  PendingSendType.tp_doc = "scheduled datagram wire moment";
+  if (PyType_Ready(&PendingSendType) < 0) return nullptr;
+
+  PendingDeliverType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+  PendingDeliverType.tp_dealloc = PendingDeliver_dealloc;
+  PendingDeliverType.tp_traverse = PendingDeliver_traverse;
+  PendingDeliverType.tp_doc = "scheduled datagram delivery";
+  if (PyType_Ready(&PendingDeliverType) < 0) return nullptr;
+
+  g_ip_loopback = PyUnicode_InternFromString("127.0.0.1");
+  g_ip_zero = PyUnicode_InternFromString("0.0.0.0");
+  g_rpc_req_str = PyUnicode_InternFromString("rpc_req");
+  if (!g_ip_loopback || !g_ip_zero || !g_rpc_req_str) return nullptr;
+
 #define INTERN(var, name)                     \
   var = PyUnicode_InternFromString(name);     \
   if (!var) return nullptr;
@@ -1535,6 +2360,17 @@ PyMODINIT_FUNC PyInit_hostcore(void) {
   INTERN(s_running_task, "running_task")
   INTERN(s_panic, "panic")
   INTERN(s_handle_panic, "_handle_panic")
+  INTERN(s_buggify_enabled, "buggify_enabled")
+  INTERN(s_send_phase2, "_send_phase2")
+  INTERN(s_deliver_m, "deliver")
+  INTERN(s_executor, "executor")
+  INTERN(s_msg_count, "msg_count")
+  INTERN(s_packet_loss_rate, "packet_loss_rate")
+  INTERN(s_lat_min, "send_latency_min_ns")
+  INTERN(s_lat_max, "send_latency_max_ns")
+  INTERN(s_spike_prob, "delay_spike_prob")
+  INTERN(s_spike_min, "delay_spike_min_ns")
+  INTERN(s_spike_max, "delay_spike_max_ns")
 #undef INTERN
 
   PyObject* m = PyModule_Create(&hostcore_module);
@@ -1556,6 +2392,20 @@ PyMODINIT_FUNC PyInit_hostcore(void) {
   if (PyModule_AddObject(m, "TaskWaker",
                          reinterpret_cast<PyObject*>(&TaskWakerType)) < 0) {
     Py_DECREF(&TaskWakerType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  Py_INCREF(&RecvDeadlineType);
+  if (PyModule_AddObject(m, "RecvDeadline",
+                         reinterpret_cast<PyObject*>(&RecvDeadlineType)) < 0) {
+    Py_DECREF(&RecvDeadlineType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  Py_INCREF(&NetCoreType);
+  if (PyModule_AddObject(m, "NetCore",
+                         reinterpret_cast<PyObject*>(&NetCoreType)) < 0) {
+    Py_DECREF(&NetCoreType);
     Py_DECREF(m);
     return nullptr;
   }

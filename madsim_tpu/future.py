@@ -100,8 +100,16 @@ class _Await:
             p.drop()
 
 
-def await_(pollable: Pollable) -> _Await:
-    """Turn a Pollable into an awaitable: ``value = await await_(p)``."""
+def await_(pollable: Pollable):
+    """Turn a Pollable into an awaitable: ``value = await await_(p)``.
+
+    With the native core, the AwaitIter IS the awaitable (its type has
+    am_await = self), skipping the _Await wrapper object per await."""
+    it = _AwaitIter
+    if it is None and not _await_iter_resolved:
+        it = _resolve_await_iter()
+    if it is not None:
+        return it(pollable)
     return _Await(pollable)
 
 

@@ -13,7 +13,7 @@ reliable ordered payload channels for connection-oriented protocols
 from __future__ import annotations
 
 from collections import deque
-from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, NamedTuple, Optional, Tuple
 
 from .. import _context
 from .. import time as sim_time
@@ -29,13 +29,13 @@ from .network import (
 )
 
 
-class Message:
-    __slots__ = ("tag", "payload", "from_addr")
-
-    def __init__(self, tag: int, payload: Any, from_addr: Addr):
-        self.tag = tag
-        self.payload = payload
-        self.from_addr = from_addr
+class Message(NamedTuple):
+    # a NamedTuple, not a __slots__ class: messages are minted on the
+    # datagram hot path (incl. by the native NetCore) and tuple.__new__
+    # skips the Python __init__ frame entirely
+    tag: int
+    payload: Any
+    from_addr: Addr
 
 
 class Mailbox:
@@ -331,8 +331,16 @@ class Endpoint:
         """Move any object to the destination mailbox
         (reference: endpoint.rs:118-133 + NetSim::send mod.rs:298-334).
         `kind` ("rpc_req"/"rpc_rsp") routes RPC drop hooks."""
-        await self._net.send_raw(
-            self.node_id, self.local_addr, parse_addr(dst), tag, payload, kind=kind
+        pend = self.send_fast(dst, tag, payload, kind)
+        if pend is not None:
+            await pend
+
+    def send_fast(self, dst: Any, tag: int, payload: Any, kind: Optional[str] = None):
+        """Non-async send: None when fully scheduled, else a coroutine to
+        await (see NetSim.send_fast) — the RPC hot path uses this to skip
+        two coroutine frames per datagram."""
+        return self._net.send_fast(
+            self.node_id, self.local_addr, parse_addr(dst), tag, payload, kind
         )
 
     async def recv_from_raw(self, tag: int) -> Tuple[Any, Addr]:

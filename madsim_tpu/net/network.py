@@ -36,6 +36,9 @@ class ConnectionReset(NetError):
     pass
 
 
+_ADDR_MEMO: dict = {}
+
+
 def parse_addr(addr: Any) -> Addr:
     """Accept "ip:port", (ip, port), or bare port int."""
     if isinstance(addr, tuple):
@@ -43,8 +46,16 @@ def parse_addr(addr: Any) -> Addr:
     if isinstance(addr, int):
         return ("0.0.0.0", addr)
     if isinstance(addr, str):
-        host, _, port = addr.rpartition(":")
-        return (host or "0.0.0.0", int(port))
+        # per-string memo: address strings are a small finite set per
+        # sim, and this sits on the datagram hot path
+        got = _ADDR_MEMO.get(addr)
+        if got is None:
+            host, _, port = addr.rpartition(":")
+            got = (host or "0.0.0.0", int(port))
+            if len(_ADDR_MEMO) > 4096:
+                _ADDR_MEMO.clear()
+            _ADDR_MEMO[addr] = got
+        return got
     raise ValueError(f"cannot parse address: {addr!r}")
 
 

@@ -34,6 +34,9 @@ class RuntimeMetrics:
                 continue
             per: Dict[str, int] = {}
             for t in n.tasks:
-                per[t.location] = per.get(t.location, 0) + 1
+                loc = t.location
+                if isinstance(loc, tuple):  # (filename, lineno) spawn key
+                    loc = f"{loc[0]}:{loc[1]}"
+                per[loc] = per.get(loc, 0) + 1
             out[n.name] = per
         return out

@@ -30,9 +30,12 @@ __all__ = [
 NodeId = int
 
 
-def _caller_location(depth: int = 2) -> str:
+def _caller_location(depth: int = 2):
+    """Spawn-site key. A (filename, lineno) tuple, NOT a formatted
+    string: spawns are the RPC hot path (handler-per-request), and the
+    f-string format was measurable; metrics format it at report time."""
     frame = sys._getframe(depth)
-    return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+    return (frame.f_code.co_filename, frame.f_lineno)
 
 
 def spawn(coro: Coroutine, *, name: str = "") -> JoinHandle:
