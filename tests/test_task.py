@@ -318,3 +318,43 @@ def test_hostname_and_default_node_names():
     assert got[0] == "madsim-main"
     assert got[1].startswith("madsim-node-")
     assert got[2] == "web-1"
+
+
+def test_runtime_graphs_are_reclaimed_across_sims():
+    """Regression for the round-5 leak find: the native Rng's strong
+    TimeCore reference (bind_time) closed an uncollectable cycle through
+    the whole runtime graph, so any simulation ending with a task parked
+    on a timer leaked its executor, tasks and wakers (~60 KB/seed).
+    With Rng's GC support, back-to-back sims must leave no TaskEntry
+    alive once collected."""
+    import gc
+
+    from madsim_tpu import time as sim_time
+    from madsim_tpu.net import Endpoint
+
+    from madsim_tpu.runtime import Handle
+
+    async def scenario():
+        handle = Handle.current()
+        a = handle.create_node().name("leak-a").ip("10.99.0.1").build()
+
+        async def srv():
+            ep = await Endpoint.bind("0.0.0.0:700")
+            await sim_time.sleep(10)  # parked on a timer at teardown
+
+        a.spawn(srv())
+        await sim_time.sleep(0.5)
+
+    import weakref
+
+    probes = []
+    for seed in range(20):
+        rt = Runtime(seed=seed)
+        rt.block_on(scenario())
+        # track only THIS test's executors: counting every live
+        # TaskEntry process-wide would trip on unrelated retention
+        probes.append(weakref.ref(rt.executor))
+    del rt
+    gc.collect()
+    alive = sum(1 for w in probes if w() is not None)
+    assert alive == 0, f"{alive}/20 executors (runtime graphs) survived collection"
