@@ -375,6 +375,54 @@ def test_gateway_wire_conformance_edges():
     assert asyncio.run(main())
 
 
+def test_wire_codec_robust_against_malformed_blobs():
+    """Garbage bytes into the record decoder must never crash (truncated
+    trailers are silently dropped, unsupported codecs raise the typed
+    error); garbage frames into a live gateway must at worst close the
+    connection — never kill the server or poison later clients."""
+    import random
+
+    from madsim_tpu.services.kafka.wire import UnsupportedCodec, decode_record_blob
+
+    rng = random.Random(7)
+    for _ in range(300):
+        blob = bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, 200)))
+        try:
+            out = decode_record_blob(blob)
+            assert isinstance(out, list)
+        except UnsupportedCodec:
+            pass  # the one allowed (typed) escape
+
+    async def main():
+        gw = KafkaWireGateway()
+        port = await gw.start()
+        gw.broker.create_topic("t", 1)
+        rng2 = random.Random(11)
+        for i in range(40):
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            n = rng2.randrange(1, 120)
+            frame = bytes(rng2.getrandbits(8) for _ in range(n))
+            writer.write(struct.pack(">i", len(frame)) + frame)
+            try:
+                await writer.drain()
+                await asyncio.wait_for(reader.read(256), 1.0)
+            except (ConnectionError, asyncio.TimeoutError):
+                pass
+            writer.close()
+        # the gateway still serves real clients afterwards
+        conn = RealKafkaConn(f"127.0.0.1:{port}")
+        try:
+            await conn.call(("produce", "t", 0, None, b"alive", 1, None))
+            msgs = await conn.call(("fetch", "t", 0, 0, 10))
+            assert [m.payload for m in msgs] == [b"alive"]
+        finally:
+            conn.close()
+            await gw.stop()
+        return True
+
+    assert asyncio.run(main())
+
+
 def test_real_mode_public_surface_against_gateway():
     """The public client surface (ClientConfig -> producer/consumer with
     group.id) in real mode, through the connect probe, against the
