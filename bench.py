@@ -62,10 +62,11 @@ def _acquire_backend() -> None:
         ensure_live_backend()
         return
 
-    # 480 s default: long enough for a transient tunnel blip to heal,
-    # short enough that window + the CPU-fallback bench (~5 min) stays
-    # inside the driver's observed patience (r4's run survived ~10 min)
-    window_s = float(os.environ.get("MADSIM_TPU_BENCH_RETRY_WINDOW_S", "480"))
+    # 300 s default: long enough for a transient tunnel blip to heal
+    # (two full probes + backoff), short enough that window + the
+    # CPU-fallback bench (~5 min) stays inside the driver's observed
+    # ~10 min patience (r4's run survived that long)
+    window_s = float(os.environ.get("MADSIM_TPU_BENCH_RETRY_WINDOW_S", "300"))
     probe_timeout = float(os.environ.get("MADSIM_TPU_BENCH_PROBE_TIMEOUT_S", "130"))
     _BACKEND_INFO["retry_window_s"] = window_s
     deadline = time.time() + window_s

@@ -395,28 +395,30 @@ def test_wire_codec_robust_against_malformed_blobs():
 
     async def main():
         gw = KafkaWireGateway()
-        port = await gw.start()
-        gw.broker.create_topic("t", 1)
-        rng2 = random.Random(11)
-        for i in range(40):
-            reader, writer = await asyncio.open_connection("127.0.0.1", port)
-            n = rng2.randrange(1, 120)
-            frame = bytes(rng2.getrandbits(8) for _ in range(n))
-            writer.write(struct.pack(">i", len(frame)) + frame)
-            try:
-                await writer.drain()
-                await asyncio.wait_for(reader.read(256), 1.0)
-            except (ConnectionError, asyncio.TimeoutError):
-                pass
-            writer.close()
-        # the gateway still serves real clients afterwards
-        conn = RealKafkaConn(f"127.0.0.1:{port}")
         try:
-            await conn.call(("produce", "t", 0, None, b"alive", 1, None))
-            msgs = await conn.call(("fetch", "t", 0, 0, 10))
-            assert [m.payload for m in msgs] == [b"alive"]
+            port = await gw.start()
+            gw.broker.create_topic("t", 1)
+            rng2 = random.Random(11)
+            for i in range(40):
+                reader, writer = await asyncio.open_connection("127.0.0.1", port)
+                n = rng2.randrange(1, 120)
+                frame = bytes(rng2.getrandbits(8) for _ in range(n))
+                writer.write(struct.pack(">i", len(frame)) + frame)
+                try:
+                    await writer.drain()
+                    await asyncio.wait_for(reader.read(256), 1.0)
+                except (ConnectionError, asyncio.TimeoutError):
+                    pass
+                writer.close()
+            # the gateway still serves real clients afterwards
+            conn = RealKafkaConn(f"127.0.0.1:{port}")
+            try:
+                await conn.call(("produce", "t", 0, None, b"alive", 1, None))
+                msgs = await conn.call(("fetch", "t", 0, 0, 10))
+                assert [m.payload for m in msgs] == [b"alive"]
+            finally:
+                conn.close()
         finally:
-            conn.close()
             await gw.stop()
         return True
 
