@@ -43,7 +43,7 @@ def _acquire_backend() -> None:
     weak #1: a single 120 s probe with no retry cost round 4 its chip
     number when the tunnel dropped at bench time). Probes device init in
     SUBPROCESSES — a wedged in-process PJRT init can never be retried —
-    with backoff until MADSIM_TPU_BENCH_RETRY_WINDOW_S (default 600)
+    with backoff until MADSIM_TPU_BENCH_RETRY_WINDOW_S (default 300)
     elapses, then re-execs onto CPU recording why. The attempt count and
     fallback reason land in the output JSON either way."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

@@ -40,33 +40,61 @@ def test_gossip_33_nodes_clean_under_full_vocabulary():
     assert int((res.summary["committed"] == 6).sum()) > 80
 
 
-def test_group_masks_past_30_nodes_split_both_sides():
-    """The lifted two-word mask: 33-node group faults draw masks with a
-    populated high word and the fault branch clogs exactly the
-    cross-group links (no silent 30-bit clamp)."""
+@pytest.mark.parametrize(
+    "n,queue,seeds",
+    [(33, 256, 40), (60, 448, 30)],  # just past the old cap; the new cap's edge
+)
+def test_group_masks_past_30_nodes_split_both_sides(n, queue, seeds):
+    """The lifted two-word mask: group faults at n > 30 draw masks with
+    a populated high word (bits 30..n-1) and the schedule splits the
+    nodes non-trivially — no silent 30-bit clamp, no overflow, no empty
+    side. Schedule-level (init only — a full 60-node CPU run is
+    minutes; the 40-node stepping test and the chip sweep cover
+    execution)."""
     from madsim_tpu.differential import fault_schedule
 
-    eng = _engine(faults=FaultPlan(
-        n_faults=3, allow_partition=False, allow_kill=False, allow_group=True,
-        t_max_us=3_000_000,
-    ))
+    eng = _engine(
+        machine=GossipMachine(num_nodes=n, rumors=4),
+        faults=FaultPlan(
+            n_faults=3, allow_partition=False, allow_kill=False,
+            allow_group=True, t_max_us=3_000_000,
+        ),
+        queue=queue,
+    )
     hi_seen = 0
-    for seed in range(40):
+    for seed in range(seeds):
         for ev in fault_schedule(eng, seed):
             if ev["op"] == F_CLOG_GROUP:
                 bits = [(ev["a"] >> i) & 1 for i in range(30)] + [
-                    (ev["b"] >> i) & 1 for i in range(3)
+                    (ev["b"] >> i) & 1 for i in range(n - 30)
                 ]
                 n_in = sum(bits)
-                assert 1 <= n_in <= 32, "mask must split 33 nodes non-trivially"
-                if any(b for b in bits[30:]):
-                    hi_seen += 1
-    assert hi_seen > 0, "high-word mask bits (nodes 30-32) never drawn"
+                assert 1 <= n_in <= n - 1, f"mask must split {n} nodes non-trivially"
+                hi_seen += any(bits[30:])
+    assert hi_seen > 0, f"high-word mask bits (nodes 30-{n-1}) never drawn"
 
 
 def test_group_partitions_beyond_60_nodes_rejected_typed():
     with pytest.raises(ValueError, match="two-word"):
         _engine(machine=GossipMachine(num_nodes=61, rumors=4))
+
+
+def test_gossip_40_nodes_steps_and_commits():
+    """A (smaller) past-the-cap machine actually STEPS: 40 nodes with
+    group faults run to quorum commits under the two-word masks."""
+    eng = _engine(
+        machine=GossipMachine(num_nodes=40, rumors=2),
+        faults=FaultPlan(
+            n_faults=1, allow_partition=False, allow_kill=False,
+            allow_group=True, t_max_us=1_000_000,
+            dur_min_us=100_000, dur_max_us=300_000,
+        ),
+        queue=320,
+    )
+    res = eng.make_runner(max_steps=6000)(jnp.arange(4, dtype=jnp.uint32))
+    codes = {int(c) for c in res.fail_code.tolist() if c}
+    assert not codes, codes
+    assert int(res.summary["committed"].sum()) >= 6  # most rumors committed
 
 
 def test_dup_ack_counting_bug_commits_below_quorum():
