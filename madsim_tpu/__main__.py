@@ -515,10 +515,56 @@ def cmd_serve(args) -> int:
 
 
 def cmd_bench(args) -> int:
-    import bench  # repo-root bench.py when run from checkout
+    if args.lanes < 0 or args.reps < 1 or args.seeds < 1:
+        sys.exit("bench needs --lanes >= 1 (or 0 = default), --reps >= 1, --seeds >= 1")
+    if not getattr(args, "machine", None):
+        import bench  # repo-root bench.py when run from checkout
 
-    sys.argv = ["bench.py"] + ([str(args.lanes)] if args.lanes else [])
-    bench.main()
+        argv = ["bench.py"]
+        if args.lanes or args.reps != 3:
+            argv.append(str(args.lanes or 8192))
+        if args.reps != 3:
+            argv.append(str(args.reps))
+        sys.argv = argv
+        bench.main()
+        return 0
+
+    # per-machine throughput: stream `--seeds` with the same statistical
+    # discipline as the flagship bench (compile + warm, median of reps)
+    import statistics
+    import time as wall
+
+    import jax
+
+    eng = _build_engine(args)
+    lanes = args.lanes or 8192
+    n = max(args.seeds, lanes)
+    eng.run_stream(64, batch=lanes, segment_steps=384, max_steps=args.max_steps)
+    eng.run_stream(n, batch=lanes, segment_steps=384, seed_start=500_000,
+                   max_steps=args.max_steps)
+    rates = []
+    fails = 0
+    for r in range(args.reps):
+        t0 = wall.perf_counter()
+        out = eng.run_stream(
+            n, batch=lanes, segment_steps=384,
+            seed_start=args.seed + r * 4 * n, max_steps=args.max_steps,
+        )
+        rates.append(out["completed"] / (wall.perf_counter() - t0))
+        fails += len(out["failing"])
+    print(json.dumps({
+        "metric": f"{args.machine}_seeds_per_sec",
+        "value": round(statistics.median(rates), 1),
+        "unit": "seeds/sec",
+        "platform": jax.devices()[0].platform,
+        "diagnostics": {
+            "reps": [round(x, 1) for x in rates],
+            "failing_total": fails,
+            "lanes": lanes,
+            "queue_capacity": args.queue,
+            "fault_kinds": getattr(args, "fault_kinds", "pair,kill"),
+        },
+    }))
     return 0
 
 
@@ -603,9 +649,18 @@ def main(argv=None) -> int:
     p.add_argument("--seeds", type=int, default=64)
     p.set_defaults(fn=cmd_check)
 
-    p = sub.add_parser("bench", help="flagship benchmark (one JSON line)")
+    p = sub.add_parser(
+        "bench",
+        help="flagship benchmark (one JSON line); with --machine, a "
+        "streaming throughput bench of any registered machine",
+    )
+    common(p)  # one source of truth for the engine flags
     p.add_argument("--lanes", type=int, default=0)
-    p.set_defaults(fn=cmd_bench)
+    p.add_argument("--seeds", type=int, default=16384, help="seeds per rep")
+    p.add_argument("--reps", type=int, default=3)
+    # bench-specific defaults: no machine = the flagship bench.py, and
+    # timed seed ranges start clear of the validation sweeps
+    p.set_defaults(fn=cmd_bench, machine=None, seed=1_000_000)
 
     p = sub.add_parser(
         "serve",
