@@ -70,3 +70,15 @@ def test_group_consumers_example():
     r = _run("group_consumers.py", "7")
     assert r.returncode == 0, r.stderr[-500:]
     assert "at-least-once holds" in r.stdout
+
+
+def test_delay_hunt_example():
+    r = _run("delay_hunt.py")
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "delay spikes" in r.stdout and "codes {206}" in r.stdout
+    # the vanishing vocabularies must find nothing
+    import re
+
+    for vocab in ("loss storms", r"partitions \+ kills"):
+        assert re.search(rf"{vocab}:\s*0/256 seeds flagged", r.stdout), r.stdout
+    assert "replay + shrink: seed" in r.stdout
