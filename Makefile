@@ -42,3 +42,8 @@ explore:
 examples:
 	$(PY) examples/raft_host.py 10
 	$(PY) examples/chaos_pipeline.py 42
+	$(PY) examples/delay_hunt.py
+
+# the round-5 chip sweeps, one shot (run when the TPU tunnel answers)
+chip-sweeps:
+	sh benches/chip_sweeps_r5.sh
