@@ -419,8 +419,8 @@ class RealKafkaConn:
                     records = decode_record_blob(blob)
                 except UnsupportedCodec as exc:
                     raise KafkaError(
-                        f"{exc} — produce with compression_type=none for the "
-                        f"stdlib wire client", ErrorCode.INVALID_ARG,
+                        f"{exc} — produce with compression_type=none or gzip "
+                        f"for the stdlib wire client", ErrorCode.INVALID_ARG,
                     ) from None
                 for off, key, value, ts, headers in records:
                     # a batch may start before the requested offset

@@ -25,6 +25,7 @@ which is the entire point of this module.
 
 from __future__ import annotations
 
+import gzip
 import struct
 import zlib
 from typing import Any, List, Optional, Sequence, Tuple
@@ -60,6 +61,9 @@ class ApiKey:
     DESCRIBE_GROUPS = 15
     API_VERSIONS = 18
     CREATE_TOPICS = 19
+
+
+MAX_DECOMPRESSED_BATCH = 64 * 1024 * 1024  # bound for peer-supplied gzip
 
 
 class UnsupportedCodec(ValueError):
@@ -102,6 +106,8 @@ class Reader:
         self.pos = pos
 
     def _take(self, n: int) -> bytes:
+        if n < 0:
+            raise ValueError(f"negative read of {n} at {self.pos}")
         b = self.buf[self.pos : self.pos + n]
         if len(b) < n:
             raise ValueError(f"frame truncated at {self.pos}+{n}")
@@ -349,9 +355,10 @@ def decode_record_blob(blob: bytes) -> List[Record]:
                 _magic = r.i8()
                 _crc = r.u32()
                 attrs = r.i16()
-                if attrs & 0x7:  # compression codec bits
+                codec = attrs & 0x7
+                if codec not in (0, 1):  # 1 = gzip (stdlib-decodable)
                     raise UnsupportedCodec(
-                        f"compressed record batch (codec {attrs & 0x7}) not supported"
+                        f"compressed record batch (codec {codec}) not supported"
                     )
                 _last_delta = r.i32()
                 first_ts = r.i64()
@@ -360,24 +367,47 @@ def decode_record_blob(blob: bytes) -> List[Record]:
                 _pepoch = r.i16()
                 _bseq = r.i32()
                 n = r.i32()
+                if codec == 1:
+                    # gzip: the records section (after the count) is one
+                    # compressed blob to the end of the batch. Bounded
+                    # decompression: peer-controlled bytes must not be
+                    # able to balloon memory (a ~1 MB bomb can expand
+                    # 1000x), and a lying size field must not read
+                    # backwards (_take rejects negative spans).
+                    comp = r._take(start + 12 + size - r.pos)
+                    try:
+                        d = zlib.decompressobj(wbits=31)  # gzip framing
+                        plain = d.decompress(comp, MAX_DECOMPRESSED_BATCH)
+                        if d.unconsumed_tail:
+                            raise UnsupportedCodec(
+                                f"gzip batch exceeds {MAX_DECOMPRESSED_BATCH} "
+                                f"bytes decompressed"
+                            )
+                        sub = Reader(plain)
+                    except UnsupportedCodec:
+                        raise
+                    except Exception as exc:  # noqa: BLE001
+                        raise UnsupportedCodec(f"bad gzip batch: {exc}") from None
+                else:
+                    sub = r
                 for _ in range(n):
-                    rec_len = r.varint()
-                    rec_end = r.pos + rec_len
-                    _rattrs = r.i8()
-                    ts_delta = r.varint()
-                    off_delta = r.varint()
-                    klen = r.varint()
-                    key = r._take(klen) if klen >= 0 else None
-                    vlen = r.varint()
-                    value = r._take(vlen) if vlen >= 0 else None
+                    rec_len = sub.varint()
+                    rec_end = sub.pos + rec_len
+                    _rattrs = sub.i8()
+                    ts_delta = sub.varint()
+                    off_delta = sub.varint()
+                    klen = sub.varint()
+                    key = sub._take(klen) if klen >= 0 else None
+                    vlen = sub.varint()
+                    value = sub._take(vlen) if vlen >= 0 else None
                     headers: List[Tuple[str, bytes]] = []
-                    for _h in range(r.varint()):
-                        hklen = r.varint()
-                        hk = r._take(hklen).decode("utf-8")
-                        hvlen = r.varint()
-                        hv = r._take(hvlen) if hvlen >= 0 else None
+                    for _h in range(sub.varint()):
+                        hklen = sub.varint()
+                        hk = sub._take(hklen).decode("utf-8")
+                        hvlen = sub.varint()
+                        hv = sub._take(hvlen) if hvlen >= 0 else None
                         headers.append((hk, hv))
-                    r.pos = rec_end
+                    sub.pos = rec_end
                     out.append(
                         (base_offset + off_delta, key, value,
                          first_ts + ts_delta, headers)

@@ -308,7 +308,9 @@ def test_gateway_wire_conformance_edges():
             assert r.i16() == Err.UNSUPPORTED_VERSION
             assert r.i32() > 0  # array still present for the downgrade
 
-            # compressed record batch -> CORRUPT_MESSAGE, nothing stored
+            # an UNDECODABLE compressed batch (gzip bit set on bytes
+            # that are not gzip) -> CORRUPT_MESSAGE, nothing stored
+            # (valid gzip is accepted — see the gzip round-trip test)
             blob = bytearray(encode_record_batch([(0, None, b"x", 1, [])]))
             # attributes i16 lives at offset 8+4+4+1+4 = 21; set gzip
             # in its low byte (22)
@@ -369,6 +371,65 @@ def test_gateway_wire_conformance_edges():
             assert r.i16() == Err.NONE
         finally:
             wire.close()
+            await gw.stop()
+        return True
+
+    assert asyncio.run(main())
+
+
+def test_gateway_accepts_gzip_record_batches():
+    """Modern producers default-compress; gzip v2 batches (the one codec
+    stdlib can decode) must produce successfully through the gateway —
+    other codecs still get the loud CORRUPT_MESSAGE rejection."""
+    import gzip
+
+    from madsim_tpu.services.kafka.real_client import _BrokerWire
+    from madsim_tpu.services.kafka.wire import encode_record_batch
+
+    def gzip_batch(recs):
+        plain = encode_record_batch(recs)
+        hdr = 8 + 4 + 4 + 1 + 4 + 2 + 4 + 8 + 8 + 8 + 2 + 4 + 4  # ..numRecords
+        body = bytearray(plain[:hdr] + gzip.compress(plain[hdr:]))
+        body[21:23] = struct.pack(">h", 1)  # attributes: codec = gzip
+        body[8:12] = struct.pack(">i", len(body) - 12)  # batchLength
+        return bytes(body)
+
+    async def main():
+        gw = KafkaWireGateway()
+        try:
+            port = await gw.start()
+            gw.broker.create_topic("gz", 1)
+            wire = _BrokerWire("127.0.0.1", port)
+            try:
+                blob = gzip_batch(
+                    [(0, b"k", b"compressed-v", 42, [("h", b"x")])]
+                )
+                w = Writer()
+                w.string(None).i16(-1).i32(10_000)
+
+                def t(topic):
+                    w.string(topic)
+
+                    def part(p):
+                        w.i32(p).bytes_(blob)
+
+                    w.array([0], part)
+
+                w.array(["gz"], t)
+                r = await wire.call(ApiKey.PRODUCE, 3, w.build())
+                assert r.i32() == 1 and r.string() == "gz" and r.i32() == 1
+                assert (r.i32(), r.i16(), r.i64()) == (0, Err.NONE, 0)
+            finally:
+                wire.close()
+            conn = RealKafkaConn(f"127.0.0.1:{port}")
+            try:
+                msgs = await conn.call(("fetch", "gz", 0, 0, 10))
+                assert [(m.key, m.payload, m.timestamp, m.headers) for m in msgs] == [
+                    (b"k", b"compressed-v", 42, [("h", b"x")])
+                ]
+            finally:
+                conn.close()
+        finally:
             await gw.stop()
         return True
 
