@@ -373,6 +373,17 @@ def cmd_replay(args) -> int:
     from .engine import replay
 
     eng = _build_engine(args)
+    if getattr(args, "diff_seed", None) is not None:
+        # schedule-fork debugger: replay both seeds, print the first
+        # diverging step with context (typical use: a failing seed vs
+        # its nearest passing neighbor)
+        from .engine.replay import replay_diff
+
+        replay_diff(
+            eng, args.seed, args.diff_seed, max_steps=args.max_steps,
+            context=args.diff_context,
+        )
+        return 0
     rp = replay(eng, args.seed, max_steps=args.max_steps)
     events = rp.trace[-args.tail :] if args.tail else rp.trace
     for ev in events:
@@ -610,6 +621,14 @@ def main(argv=None) -> int:
     p = sub.add_parser("replay", help="bit-identical replay of one seed with trace")
     common(p)
     p.add_argument("--tail", type=int, default=30, help="print last N events (0=all)")
+    p.add_argument(
+        "--diff-seed", type=int, default=None,
+        help="also replay this seed and print where the two event "
+        "schedules first diverge (debugging: failing seed vs its "
+        "nearest passing neighbor)",
+    )
+    p.add_argument("--diff-context", type=int, default=3,
+                   help="events of context around the divergence")
     p.set_defaults(fn=cmd_replay)
 
     p = sub.add_parser("shrink", help="minimize a failing seed's config")

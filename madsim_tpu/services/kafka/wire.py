@@ -25,7 +25,6 @@ which is the entire point of this module.
 
 from __future__ import annotations
 
-import gzip
 import struct
 import zlib
 from typing import Any, List, Optional, Sequence, Tuple
@@ -337,6 +336,7 @@ def decode_record_blob(blob: bytes) -> List[Record]:
     end, partial trailing data (fetch truncation) is ignored."""
     out: List[Record] = []
     r = Reader(blob)
+    plain_budget = MAX_DECOMPRESSED_BATCH  # aggregate across ALL batches
     while r.remaining() >= 12:
         start = r.pos
         try:
@@ -377,12 +377,22 @@ def decode_record_blob(blob: bytes) -> List[Record]:
                     comp = r._take(start + 12 + size - r.pos)
                     try:
                         d = zlib.decompressobj(wbits=31)  # gzip framing
-                        plain = d.decompress(comp, MAX_DECOMPRESSED_BATCH)
-                        if d.unconsumed_tail:
+                        # the budget is shared across every batch in the
+                        # blob: many small bombs must not add up past it
+                        plain = d.decompress(comp, plain_budget + 1)
+                        if len(plain) > plain_budget or d.unconsumed_tail:
                             raise UnsupportedCodec(
-                                f"gzip batch exceeds {MAX_DECOMPRESSED_BATCH} "
+                                f"gzip batches exceed {MAX_DECOMPRESSED_BATCH} "
                                 f"bytes decompressed"
                             )
+                        if not d.eof:
+                            # size-complete batch but the gzip stream is
+                            # cut short: always corruption, never fetch
+                            # truncation — reject loudly (a silent 0-
+                            # record decode would let the gateway ACK a
+                            # produce while dropping its records)
+                            raise UnsupportedCodec("truncated gzip batch")
+                        plain_budget -= len(plain)
                         sub = Reader(plain)
                     except UnsupportedCodec:
                         raise
