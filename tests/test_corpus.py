@@ -93,3 +93,20 @@ def test_hunt_then_regress_cli(tmp_path):
     assert cmd_regress(argparse.Namespace(corpus=path, promote=True)) == 0
     assert corpus.load(path)[0].status == corpus.STATUS_FIXED
     assert cmd_regress(argparse.Namespace(corpus=path, promote=False)) == 0
+
+
+def test_replay_diff_cli(capsys):
+    """`replay --diff-seed` prints the first schedule divergence between
+    two seeds (the debugging workflow for comparing a failing seed with
+    a passing neighbor)."""
+    from madsim_tpu.__main__ import cmd_replay
+
+    args = argparse.Namespace(
+        machine="raft", nodes=0, seed=3, horizon=3.0, queue=96, faults=2,
+        loss=0.0, max_steps=1500, fault_tmax=0, tail=5,
+        diff_seed=4, diff_context=2,
+    )
+    assert cmd_replay(args) == 0
+    out = capsys.readouterr().out
+    assert "diverge" in out or "prefix-match" in out or "identical" in out
+    assert "seed 3" in out and "seed 4" in out
