@@ -28,7 +28,7 @@ python -m madsim_tpu bench --machine multipaxos --lanes 8192 --seeds 106000 \
 # 4. Gossip 33-node at 100k seeds, full vocabulary incl. delay
 #    (directive 6: the larger-n PROFILE row)
 python -m madsim_tpu bench --machine gossip --nodes 33 --lanes 8192 \
-  --seeds 100000 --reps 1 --horizon 5 --queue 256 --faults 3 \
+  --seeds 100000 --reps 1 --horizon 5 --queue 320 --faults 3 \
   --fault-kinds pair,kill,dir,group,storm,delay --fault-tmax 3000000 \
   --max-steps 9000
 
