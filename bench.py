@@ -139,23 +139,32 @@ def main() -> None:
     )
     eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
 
+    # Pipelined executor (round-6): device-side supersegments + donated
+    # StreamCarry + K-deep async dispatch. MADSIM_TPU_STREAM_PIPELINE=0
+    # restores the r5 per-segment driver (bit-identical results) for
+    # A/B measurement.
+    pipelined = os.environ.get("MADSIM_TPU_STREAM_PIPELINE", "1") not in ("", "0")
+    run = eng.make_stream_runner(
+        batch=lanes, segment_steps=segment_steps, pipelined=pipelined,
+    )
+
     # Warmup 1: compile the streaming path at the timed batch size.
     # Warmup 2: a full-size untimed run to bring the chip to a steady
     # power/clock state (a cold first rep reads 10-20% low).
-    eng.run_stream(1, batch=lanes, segment_steps=segment_steps)
-    eng.run_stream(2 * lanes, batch=lanes, segment_steps=segment_steps, seed_start=500_000)
+    run(1)
+    run(2 * lanes, seed_start=500_000)
 
     # Timed: `reps` independent repetitions over disjoint seed ranges;
     # seed streaming keeps every lane busy (finished lanes refill with
     # fresh seeds each segment, so stragglers never idle the batch).
     rates = []
+    out = None
     for r in range(reps):
         t0 = time.perf_counter()
-        out = eng.run_stream(
-            2 * lanes, batch=lanes, segment_steps=segment_steps, seed_start=1_000_000 + r * 4 * lanes
-        )
+        out = run(2 * lanes, seed_start=1_000_000 + r * 4 * lanes)
         elapsed = time.perf_counter() - t0
         rates.append(out["completed"] / elapsed)
+    stream_stats = out["stats"]
 
     seeds_per_sec = statistics.median(rates)
     per_chip_target = 10_000 / 8  # north star is for a v5e-8; we have 1 chip
@@ -181,6 +190,14 @@ def main() -> None:
                     "lanes": lanes,
                     "segment_steps": segment_steps,
                     "queue_capacity": cfg.queue_capacity,
+                    # pipelined-executor evidence (last rep): blocking
+                    # device->host syncs vs segments the device ran
+                    "host_syncs": stream_stats["host_syncs"],
+                    "device_segments": stream_stats["device_segments"],
+                    "dispatch_depth": stream_stats["dispatch_depth"],
+                    "segments_per_dispatch": stream_stats["segments_per_dispatch"],
+                    "donation": stream_stats["donation"],
+                    "pipelined": stream_stats["pipelined"],
                 },
             }
         )

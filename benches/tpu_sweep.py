@@ -33,12 +33,22 @@ def run_point(batch: int, segment_steps: int) -> dict:
         faults=FaultPlan(n_faults=2, t_max_us=3_000_000, dur_min_us=200_000, dur_max_us=800_000),
     )
     eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
+    # pipelined-executor knobs (round-6), env-tunable for A/B sweeps:
+    # MADSIM_TPU_STREAM_PIPELINE=0 restores the r5 per-segment driver
+    run = eng.make_stream_runner(
+        batch=batch,
+        segment_steps=segment_steps,
+        pipelined=os.environ.get("MADSIM_TPU_STREAM_PIPELINE", "1") not in ("", "0"),
+        segments_per_dispatch=int(os.environ.get("MADSIM_TPU_STREAM_SUPERSEG", "8")),
+        dispatch_depth=int(os.environ.get("MADSIM_TPU_STREAM_DEPTH", "4")),
+    )
     t_c0 = time.perf_counter()
-    eng.run_stream(1, batch=batch, segment_steps=segment_steps)
+    run(1)
     compile_s = time.perf_counter() - t_c0
     t0 = time.perf_counter()
-    out = eng.run_stream(3 * batch, batch=batch, segment_steps=segment_steps, seed_start=1_000_000)
+    out = run(3 * batch, seed_start=1_000_000)
     elapsed = time.perf_counter() - t0
+    st = out["stats"]
     return {
         "batch": batch,
         "segment_steps": segment_steps,
@@ -48,6 +58,10 @@ def run_point(batch: int, segment_steps: int) -> dict:
         "elapsed_s": round(elapsed, 2),
         "compile_s": round(compile_s, 1),
         "platform": jax.devices()[0].platform,
+        "host_syncs": st["host_syncs"],
+        "device_segments": st["device_segments"],
+        "pipelined": st["pipelined"],
+        "donation": st["donation"],
     }
 
 

@@ -75,8 +75,13 @@ def main() -> None:
         print("   ", ev)
 
     # a verified-passing neighbor: completed, not failing, not abandoned,
-    # and confirmed by replay (in-flight-at-exit seeds don't count)
-    excluded = {s for s, _ in out["failing"]} | set(out["abandoned"])
+    # not an infra artifact (queue overflow), and confirmed by replay
+    # (in-flight-at-exit seeds don't count)
+    excluded = (
+        {s for s, _ in out["failing"]}
+        | {s for s, _ in out["infra"]}
+        | set(out["abandoned"])
+    )
     passing = None
     for cand in range(out["seeds_consumed"]):
         if cand in excluded:

@@ -171,26 +171,52 @@ def _repro_line(args, seed) -> str:
     )
 
 
+def _stream_kwargs(args) -> dict:
+    """Pipelined-executor knobs shared by explore/hunt/bench (default:
+    pipelined + donated; --no-pipeline restores the r5 per-segment
+    driver, kept for one release)."""
+    return {
+        "pipelined": not getattr(args, "no_pipeline", False),
+        "segments_per_dispatch": getattr(args, "segments_per_dispatch", 8),
+        "dispatch_depth": getattr(args, "dispatch_depth", 4),
+        "donate": not getattr(args, "no_donate", False),
+    }
+
+
+def _split_infra(failing):
+    """Partition (seed, code) pairs into (findings, infra): OVERFLOW is
+    a fixed-shape capacity abort — an infrastructure artifact that says
+    "rerun with a bigger --queue", never a protocol finding."""
+    from .engine import OVERFLOW
+
+    pairs = list(failing)
+    findings = [(s, c) for s, c in pairs if c != OVERFLOW]
+    infra = [(s, c) for s, c in pairs if c == OVERFLOW]
+    return findings, infra
+
+
 def _find_failing(eng, args):
     """Run the seed batch (streaming or fixed) and return
-    (failing [(seed, code), ...], abandoned_count)."""
+    (failing [(seed, code), ...], infra [(seed, code), ...],
+    abandoned_count)."""
     if args.stream:
         out = eng.run_stream(
             args.seeds, batch=min(args.seeds, args.batch), segment_steps=384,
             seed_start=args.seed, max_steps=args.max_steps,
+            **_stream_kwargs(args),
         )
-        return out["failing"], len(out["abandoned"])
+        return out["failing"], out["infra"], len(out["abandoned"])
     import jax.numpy as jnp
 
     seeds = jnp.arange(args.seed, args.seed + args.seeds, dtype=jnp.uint32)
     res = eng.make_runner(max_steps=args.max_steps)(seeds)
-    failing = [
+    failing, infra = _split_infra(
         (int(s), int(c))
         for s, c in zip(
             eng.failing_seeds(res).tolist(), res.fail_code[res.failed].tolist()
         )
-    ]
-    return failing, 0
+    )
+    return failing, infra, 0
 
 
 def cmd_explore(args) -> int:
@@ -228,18 +254,27 @@ def cmd_explore(args) -> int:
         import time as wall
 
         batch = min(args.seeds, args.batch)
-        eng.run_stream(1, batch=batch, segment_steps=384, max_steps=args.max_steps)
+        sk = _stream_kwargs(args)
+        eng.run_stream(1, batch=batch, segment_steps=384, max_steps=args.max_steps, **sk)
         t0 = wall.perf_counter()
         out = eng.run_stream(
             args.seeds, batch=batch, segment_steps=384,
-            seed_start=args.seed, max_steps=args.max_steps,
+            seed_start=args.seed, max_steps=args.max_steps, **sk,
         )
         el = wall.perf_counter() - t0
         failing = out["failing"]
+        st = out["stats"]
         print(
             f"streamed {out['completed']} seeds in {el:.1f}s "
             f"({out['completed']/el:.0f} seeds/s), {len(failing)} failing, "
             f"{len(out['abandoned'])} abandoned"
+            + (f", {len(out['infra'])} infra (queue overflow)" if out["infra"] else "")
+        )
+        print(
+            f"executor: {st['device_segments']} segments, "
+            f"{st['host_syncs']} host syncs, {st['drains']} drains "
+            f"(pipelined={st['pipelined']}, donation={st['donation']}, "
+            f"depth={st['dispatch_depth']}x{st['segments_per_dispatch']})"
         )
         if failing:
             codes = sorted({c for _s, c in failing})
@@ -271,10 +306,15 @@ def cmd_hunt(args) -> int:
     from .engine import corpus, shrink
 
     eng = _build_engine(args)
-    failing, abandoned = _find_failing(eng, args)
+    failing, infra, abandoned = _find_failing(eng, args)
     print(
         f"hunted {args.seeds} seeds: {len(failing)} failing"
         + (f", {abandoned} abandoned (over --max-steps)" if abandoned else "")
+        + (
+            f", {len(infra)} infra artifacts (queue overflow — rerun "
+            f"with a bigger --queue; not recorded as findings)"
+            if infra else ""
+        )
     )
     entries = corpus.load(args.corpus)
     known = {e.key for e in entries}
@@ -550,19 +590,21 @@ def cmd_bench(args) -> int:
     eng = _build_engine(args)
     lanes = args.lanes or 8192
     n = max(args.seeds, lanes)
-    eng.run_stream(64, batch=lanes, segment_steps=384, max_steps=args.max_steps)
-    eng.run_stream(n, batch=lanes, segment_steps=384, seed_start=500_000,
-                   max_steps=args.max_steps)
+    run = eng.make_stream_runner(
+        batch=lanes, segment_steps=384, max_steps=args.max_steps,
+        **_stream_kwargs(args),
+    )
+    run(64)
+    run(n, seed_start=500_000)
     rates = []
     fails = 0
+    out = None
     for r in range(args.reps):
         t0 = wall.perf_counter()
-        out = eng.run_stream(
-            n, batch=lanes, segment_steps=384,
-            seed_start=args.seed + r * 4 * n, max_steps=args.max_steps,
-        )
+        out = run(n, seed_start=args.seed + r * 4 * n)
         rates.append(out["completed"] / (wall.perf_counter() - t0))
-        fails += len(out["failing"])
+        fails += len(out["failing"]) + len(out["infra"])
+    st = out["stats"]
     print(json.dumps({
         "metric": f"{args.machine}_seeds_per_sec",
         "value": round(statistics.median(rates), 1),
@@ -574,6 +616,12 @@ def cmd_bench(args) -> int:
             "lanes": lanes,
             "queue_capacity": args.queue,
             "fault_kinds": getattr(args, "fault_kinds", "pair,kill"),
+            "host_syncs": st["host_syncs"],
+            "device_segments": st["device_segments"],
+            "dispatch_depth": st["dispatch_depth"],
+            "segments_per_dispatch": st["segments_per_dispatch"],
+            "donation": st["donation"],
+            "pipelined": st["pipelined"],
         },
     }))
     return 0
@@ -603,6 +651,27 @@ def main(argv=None) -> int:
             "other kind switches to the v2 schedule derivation)",
         )
 
+    def stream_flags(p):
+        """Pipelined streaming-executor knobs (explore/hunt/bench)."""
+        p.add_argument(
+            "--no-pipeline", action="store_true",
+            help="use the r5 per-segment driver (one blocking host sync "
+            "per segment) instead of the pipelined executor",
+        )
+        p.add_argument(
+            "--segments-per-dispatch", type=int, default=8,
+            help="segments fused into one device dispatch (supersegment)",
+        )
+        p.add_argument(
+            "--dispatch-depth", type=int, default=4,
+            help="async dispatches in flight between blocking counter polls",
+        )
+        p.add_argument(
+            "--no-donate", action="store_true",
+            help="disable StreamCarry buffer donation (keeps the r5 "
+            "copy-per-call behavior; results are bit-identical either way)",
+        )
+
     p = sub.add_parser("explore", help="run a seed batch, report failing seeds")
     common(p)
     p.add_argument("--seeds", type=int, default=1024)
@@ -611,6 +680,7 @@ def main(argv=None) -> int:
         help="seed-streaming path (refill finished lanes; for large batches)",
     )
     p.add_argument("--batch", type=int, default=8192, help="lanes per streaming batch")
+    stream_flags(p)
     p.add_argument(
         "--multihost", action="store_true",
         help="shard the batch over a jax.distributed job "
@@ -642,6 +712,7 @@ def main(argv=None) -> int:
     p.add_argument("--seeds", type=int, default=1024)
     p.add_argument("--stream", action="store_true", help="seed-streaming hunt")
     p.add_argument("--batch", type=int, default=8192, help="lanes per streaming batch")
+    stream_flags(p)
     p.add_argument("--corpus", default="corpus.json")
     p.add_argument("--limit", type=int, default=5, help="max seeds to shrink+record")
     p.add_argument(
@@ -677,6 +748,7 @@ def main(argv=None) -> int:
     p.add_argument("--lanes", type=int, default=0)
     p.add_argument("--seeds", type=int, default=16384, help="seeds per rep")
     p.add_argument("--reps", type=int, default=3)
+    stream_flags(p)
     # bench-specific defaults: no machine = the flagship bench.py, and
     # timed seed ranges start clear of the validation sweeps
     p.set_defaults(fn=cmd_bench, machine=None, seed=1_000_000)

@@ -9,7 +9,11 @@ so the host and TPU engines agree bit-for-bit.
 from __future__ import annotations
 
 import hashlib
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # python < 3.11: tomli is API-compatible
+    import tomli as tomllib  # type: ignore[no-redef]
 from dataclasses import dataclass, field
 
 

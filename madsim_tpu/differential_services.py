@@ -60,14 +60,16 @@ def _mvcc_key(machine, k: int) -> bytes:
     return f"client/{k}".encode()
 
 
-def drive_etcd_service(machine, trace) -> "EtcdService":
+def drive_etcd_service(machine, trace, service_factory=None) -> "EtcdService":
     """Apply the device lane's delivered M_REQ stream to a real
     EtcdService, mirroring the machine's sweep-then-apply order and
-    dedup rule."""
+    dedup rule. `service_factory` (rng -> EtcdService) lets the
+    bidirectional tests drive a deliberately-bugged SERVICE build — the
+    differential must catch drift seeded on either side."""
     from .models import etcd_mvcc as M
     from .services.etcd.service import EtcdService
 
-    svc = EtcdService(_SvcRng())
+    svc = (service_factory or EtcdService)(_SvcRng())
     last_req: Dict[int, int] = {}
     lease_of: Dict[int, int] = {}  # client -> service lease id (the slot)
     last_t = 0
@@ -118,15 +120,20 @@ def drive_etcd_service(machine, trace) -> "EtcdService":
     return svc
 
 
-def differential_etcd_mvcc(engine, seed: int, max_steps: int = 3000) -> Dict:
+def differential_etcd_mvcc(
+    engine, seed: int, max_steps: int = 3000, service_factory=None
+) -> Dict:
     """One seed, both implementations, full MVCC state comparison.
 
     Returns {"ok", "mismatches": [str], "revision": (machine, service),
     "ops": n_effective} — ok=True means the machine and the L5 service
-    agree exactly on every compared MVCC fact."""
+    agree exactly on every compared MVCC fact. The check is
+    bidirectional: drift seeded in the MACHINE (NO_DEDUP variants) or
+    in the SERVICE (`service_factory` building e.g. the
+    lease_expiry_off_by_one EtcdService) both break the agreement."""
     machine = engine.machine
     rp: ReplayResult = replay(engine, seed, max_steps=max_steps)
-    svc = drive_etcd_service(machine, rp.trace)
+    svc = drive_etcd_service(machine, rp.trace, service_factory=service_factory)
     nodes = rp.state.nodes
 
     mismatches: List[str] = []

@@ -5,6 +5,11 @@ See `core.py` for the architecture. Public surface:
   * `Machine` — protocol step-function authoring base (machine.py)
   * `Engine(machine, EngineConfig)` — batch runner: `make_runner()`,
     `run_batch(seeds)`, `failing_seeds(result)`
+  * `Engine.run_stream(n_seeds, ...)` / `make_stream_runner(...)` — the
+    pipelined streaming executor: donated `StreamCarry`, device-side
+    supersegments (`segments_per_dispatch`), K-deep async dispatch
+    (`dispatch_depth`); `pipelined=False` keeps the r5 per-segment
+    driver for one release (bit-identical results either way)
   * `replay(engine, seed)` — bit-identical single-seed CPU replay
   * `FaultPlan` — randomized partition / kill-restart schedules
   * `shrink(engine, seed)` — minimize a failing seed's config (shrink.py)
@@ -18,6 +23,7 @@ from .core import (
     EngineConfig,
     FaultPlan,
     LaneState,
+    StreamCarry,
     EV_FAULT,
     EV_MSG,
     EV_TIMER,
@@ -44,6 +50,7 @@ __all__ = [
     "EngineConfig",
     "FaultPlan",
     "LaneState",
+    "StreamCarry",
     "Machine",
     "Outbox",
     "BOOT",

@@ -191,7 +191,7 @@ class LaneState:
 class StreamCarry:
     """Device-resident streaming state: lanes + seed counter + result
     rings. Everything run_stream needs per segment lives on-device; the
-    host fetches only `counters` (one small uint32[5] transfer) and
+    host fetches only `counters` (one small uint32[6] transfer) and
     drains the rings when they near capacity."""
 
     state: LaneState
@@ -199,12 +199,13 @@ class StreamCarry:
     done: jax.Array  # bool[L] — harvest mask; refilled at next segment start
     next_seed: jax.Array  # uint32 scalar
     completed: jax.Array  # int32 scalar
+    segments: jax.Array  # int32 scalar — segments executed on device
     fail_seeds: jax.Array  # uint32[C]
     fail_codes: jax.Array  # int32[C]
     fail_count: jax.Array  # int32 scalar
     ab_seeds: jax.Array  # uint32[C]
     ab_count: jax.Array  # int32 scalar
-    counters: jax.Array  # uint32[5]: completed, fail_count, ab_count, next_seed, flags
+    counters: jax.Array  # uint32[6]: completed, fail_count, ab_count, next_seed, flags, segments
 
 
 @struct.dataclass
@@ -703,17 +704,47 @@ class Engine:
         final, _ = lax.while_loop(cond, body, (state, jnp.int32(0)))
         return final
 
-    def _stream_fns(self, segment_steps: int, max_steps: int, ring_capacity: int):
+    def _stream_fns(
+        self,
+        segment_steps: int,
+        max_steps: int,
+        ring_capacity: int,
+        batch: int,
+        donate: bool = True,
+        segments_per_dispatch: int = 8,
+    ):
         """Jitted building blocks for run_stream, cached per shape-affecting
-        params (fresh jit wrappers would recompile on every call)."""
+        params (fresh jit wrappers would recompile on every call).
+
+        Returns (init_carry, segment, supersegment, reset_rings).
+
+        `segment` / `supersegment` / `reset_rings` donate their
+        StreamCarry argument when `donate` (the multi-MB lane state is
+        aliased in place instead of copied in HBM every call; toggle
+        kept for one release so bit-identity vs the undonated path stays
+        assertable). A donated carry is CONSUMED: never touch a carry
+        after passing it back in — read counters/rings first.
+
+        `supersegment` is the pipelined executor's device half: an inner
+        `lax.while_loop` advances up to `segments_per_dispatch` whole
+        segments (refill + advance + harvest each) per host dispatch,
+        with the termination check (`completed < need`) and the
+        ring-pressure check ON DEVICE — the exact conditions the r5 host
+        loop evaluated between segments, so the executed segment
+        sequence is bit-identical to the per-segment driver. When a ring
+        crosses its drain mark (count > cap - batch) the loop parks
+        until the host drains, which bounds appends at `cap` regardless
+        of how many dispatches are in flight."""
         cache = getattr(self, "_stream_cache", None)
         if cache is None:
             cache = self._stream_cache = {}
-        key = (segment_steps, max_steps, ring_capacity)
+        key = (segment_steps, max_steps, ring_capacity, batch, donate,
+               segments_per_dispatch)
         if key in cache:
             return cache[key]
 
         cap = ring_capacity
+        drain_mark = cap - batch
 
         def _append_ring(buf, count, mask, values):
             """Scatter-free ordered append: masked lane of rank r (in lane
@@ -741,27 +772,28 @@ class Engine:
                     c.ab_count.astype(jnp.uint32),
                     c.next_seed,
                     over.astype(jnp.uint32),
+                    c.segments.astype(jnp.uint32),
                 ]
             )
 
         def init_carry(seeds) -> StreamCarry:
-            batch = seeds.shape[0]
             c = StreamCarry(
                 state=self.init_batch(seeds),
                 seeds=seeds,
-                done=jnp.zeros((batch,), bool),
+                done=jnp.zeros((seeds.shape[0],), bool),
                 next_seed=seeds[-1] + jnp.uint32(1),
                 completed=jnp.int32(0),
+                segments=jnp.int32(0),
                 fail_seeds=jnp.zeros((cap,), jnp.uint32),
                 fail_codes=jnp.zeros((cap,), jnp.int32),
                 fail_count=jnp.int32(0),
                 ab_seeds=jnp.zeros((cap,), jnp.uint32),
                 ab_count=jnp.int32(0),
-                counters=jnp.zeros((5,), jnp.uint32),
+                counters=jnp.zeros((6,), jnp.uint32),
             )
             return c.replace(counters=_counters(c))
 
-        def segment(c: StreamCarry) -> StreamCarry:
+        def _segment_impl(c: StreamCarry) -> StreamCarry:
             # 1. refill lanes harvested at the end of the previous segment
             #    (device-side ranks + seed counter: gapless, in lane order)
             n_refill = c.done.sum(dtype=jnp.int32)
@@ -807,6 +839,7 @@ class Engine:
                 done=done,
                 next_seed=next_seed,
                 completed=completed,
+                segments=c.segments + 1,
                 fail_seeds=fail_seeds,
                 fail_codes=fail_codes,
                 fail_count=fail_count,
@@ -816,11 +849,35 @@ class Engine:
             )
             return new.replace(counters=_counters(new))
 
+        def supersegment(c: StreamCarry, need) -> StreamCarry:
+            # The host loop's between-segment checks, moved on-device:
+            # stop at the completion target (same crossing as the r5
+            # per-segment driver — bit-identical executed-segment
+            # sequence for any dispatch depth), park on ring pressure
+            # (host must drain), else advance another whole segment.
+            def cond(carry):
+                cc, it = carry
+                pressure = (cc.fail_count > drain_mark) | (cc.ab_count > drain_mark)
+                return (it < segments_per_dispatch) & (cc.completed < need) & ~pressure
+
+            def body(carry):
+                cc, it = carry
+                return _segment_impl(cc), it + 1
+
+            final, _ = lax.while_loop(cond, body, (c, jnp.int32(0)))
+            return final
+
         def reset_rings(c: StreamCarry) -> StreamCarry:
             new = c.replace(fail_count=jnp.int32(0), ab_count=jnp.int32(0))
             return new.replace(counters=_counters(new))
 
-        fns = (jax.jit(init_carry), jax.jit(segment), jax.jit(reset_rings))
+        donate_kw = {"donate_argnums": (0,)} if donate else {}
+        fns = (
+            jax.jit(init_carry),
+            jax.jit(_segment_impl, **donate_kw),
+            jax.jit(supersegment, **donate_kw),
+            jax.jit(reset_rings, **donate_kw),
+        )
         cache[key] = fns
         return fns
 
@@ -832,16 +889,31 @@ class Engine:
         seed_start: int = 0,
         max_steps: int = 10_000,
         mesh=None,
+        pipelined: bool = True,
+        segments_per_dispatch: int = 8,
+        dispatch_depth: int = 4,
+        donate: Optional[bool] = None,
     ):
         """Continuous seed streaming: run at least n_seeds simulations
-        keeping every lane busy. Each segment is ONE fused jitted call —
-        refill previously-finished lanes with fresh seeds (device-side
-        cumsum ranks + a device-resident next-seed counter), advance
-        `segment_steps` events, then harvest completions into on-device
-        result rings. The host fetches a single small counters array per
-        segment and drains the failing/abandoned rings only when they
-        near capacity — no per-lane host round trips, so streaming scales
-        on a real chip instead of serializing device<->host every segment.
+        keeping every lane busy. Each segment — refill previously-finished
+        lanes with fresh seeds (device-side cumsum ranks + a
+        device-resident next-seed counter), advance `segment_steps`
+        events, then harvest completions into on-device result rings —
+        is fused device work; the host only ever reads the small
+        `counters` array and drains the failing/abandoned rings when
+        they near capacity.
+
+        The default PIPELINED executor dispatches `segments_per_dispatch`
+        segments per jitted call (an inner device `lax.while_loop` with
+        the termination and ring-pressure checks on-device) and keeps
+        `dispatch_depth` such calls in flight before one blocking
+        counters read — the steady state runs with ZERO blocking host
+        syncs between segments, vs one per segment for the r5 driver
+        (`pipelined=False`, kept for one release; both executors run the
+        bit-identical segment sequence, so results are equal by
+        construction). All streaming ops donate the multi-MB StreamCarry
+        (`donate=False` or MADSIM_TPU_STREAM_DONATE=0 opts out), so XLA
+        aliases the lane state in HBM instead of copying it every call.
 
         Seed coverage is gapless: exactly the range
         [seed_start, seed_start + seeds_consumed) enters lanes, in order.
@@ -851,16 +923,28 @@ class Engine:
         every streaming op (init / segment / refill / ring append) stays
         sharded by propagation — the 100k-seeds-over-a-pod configuration.
 
-        Returns {"completed", "failing": [(seed, code)...],
-        "abandoned": [seed...], "seeds_consumed"}.
+        Returns {"completed", "failing": [(seed, code)...], "infra":
+        [(seed, code)...] (infrastructure artifacts: OVERFLOW lanes —
+        queue-capacity aborts, not protocol findings), "abandoned":
+        [seed...], "seeds_consumed", "stats": {host_syncs, drains,
+        dispatches, device_segments, dispatch_depth,
+        segments_per_dispatch, donation, pipelined}}.
         """
         import numpy as np
 
-        # Ring capacity: drains trigger at cap - batch, so one segment
-        # (which can complete at most `batch` lanes) can never overflow.
+        if donate is None:
+            donate = os.environ.get("MADSIM_TPU_STREAM_DONATE", "1") not in ("", "0")
+        if segments_per_dispatch < 1 or dispatch_depth < 1:
+            raise ValueError("segments_per_dispatch and dispatch_depth must be >= 1")
+
+        # Ring capacity: the device parks at the drain mark (cap - batch),
+        # and one segment can complete at most `batch` lanes, so the
+        # rings can never overflow no matter how many dispatches are in
+        # flight.
         ring_capacity = 2 * batch
-        init_carry, segment, reset_rings = self._stream_fns(
-            segment_steps, max_steps, ring_capacity
+        init_carry, segment, supersegment, reset_rings = self._stream_fns(
+            segment_steps, max_steps, ring_capacity, batch,
+            donate=donate, segments_per_dispatch=segments_per_dispatch,
         )
 
         seeds = jnp.arange(seed_start, seed_start + batch, dtype=jnp.uint32)
@@ -871,46 +955,92 @@ class Engine:
         carry = init_carry(seeds)
 
         failing: list = []
+        infra: list = []
         abandoned: list = []
+        stats = {"host_syncs": 0, "drains": 0, "dispatches": 0}
 
         def drain(c: StreamCarry) -> StreamCarry:
             f_seeds, f_codes, f_n, a_seeds, a_n = jax.device_get(
                 (c.fail_seeds, c.fail_codes, c.fail_count, c.ab_seeds, c.ab_count)
             )
-            failing.extend(
-                (int(s), int(code))
-                for s, code in zip(f_seeds[: int(f_n)], f_codes[: int(f_n)])
-            )
+            stats["drains"] += 1
+            stats["host_syncs"] += 1
+            for s, code in zip(f_seeds[: int(f_n)], f_codes[: int(f_n)]):
+                # infra artifacts (fixed-shape overflow aborts) are kept
+                # out of the findings bucket: an OVERFLOW lane means
+                # "rerun with a bigger queue", not "protocol bug"
+                (infra if int(code) == OVERFLOW else failing).append(
+                    (int(s), int(code))
+                )
             abandoned.extend(int(s) for s in a_seeds[: int(a_n)])
             return reset_rings(c)
 
-        completed = 0
-        segments = 0
-        # hard ceiling well above the expected segment count (progress is
-        # guaranteed because over-cap lanes are abandoned at harvest)
-        max_segments = (max_steps // segment_steps + 2) * (n_seeds // batch + 2)
-        while completed < n_seeds and segments < max_segments:
-            carry = segment(carry)
-            segments += 1
-            # the one device<->host transfer of the steady-state loop
-            counters = np.asarray(jax.device_get(carry.counters))
-            completed = int(counters[0])
+        def poll(c: StreamCarry):
+            """The blocking device->host sync: one small counters read."""
+            counters = np.asarray(jax.device_get(c.counters))
+            stats["host_syncs"] += 1
             if counters[4]:
                 raise RuntimeError(
                     "run_stream result ring overflowed (drain policy bug)"
                 )
-            if (
-                int(counters[1]) > ring_capacity - batch
-                or int(counters[2]) > ring_capacity - batch
-            ):
-                carry = drain(carry)
+            return counters
+
+        drain_mark = ring_capacity - batch
+        completed = 0
+        # hard ceiling well above the expected segment count (progress is
+        # guaranteed because over-cap lanes are abandoned at harvest);
+        # pipelining adds at most dispatch_depth no-op dispatches per
+        # poll cycle, which the per-dispatch ceiling below absorbs
+        max_segments = (max_steps // segment_steps + 2) * (n_seeds // batch + 2)
+
+        if pipelined:
+            need = jnp.int32(min(n_seeds, 2**31 - 1))
+            max_dispatch = max_segments + dispatch_depth * (n_seeds // batch + 4)
+            in_flight = 0
+            while completed < n_seeds and stats["dispatches"] < max_dispatch:
+                # async dispatch: returns immediately, device work queues
+                # behind the donated carry chain
+                carry = supersegment(carry, need)
+                stats["dispatches"] += 1
+                in_flight += 1
+                if in_flight >= dispatch_depth:
+                    in_flight = 0
+                    counters = poll(carry)
+                    completed = int(counters[0])
+                    if (
+                        int(counters[1]) > drain_mark
+                        or int(counters[2]) > drain_mark
+                    ):
+                        carry = drain(carry)
+        else:
+            # r5 executor: one blocking counters read per segment
+            while completed < n_seeds and stats["dispatches"] < max_segments:
+                carry = segment(carry)
+                stats["dispatches"] += 1
+                counters = poll(carry)
+                completed = int(counters[0])
+                if (
+                    int(counters[1]) > drain_mark
+                    or int(counters[2]) > drain_mark
+                ):
+                    carry = drain(carry)
+
+        counters = poll(carry)
         carry = drain(carry)
-        counters = np.asarray(jax.device_get(carry.counters))
         return {
             "completed": int(counters[0]),
             "failing": failing,
+            "infra": infra,
             "abandoned": abandoned,
             "seeds_consumed": int(counters[3]) - seed_start,
+            "stats": {
+                **stats,
+                "device_segments": int(counters[5]),
+                "dispatch_depth": dispatch_depth if pipelined else 1,
+                "segments_per_dispatch": segments_per_dispatch if pipelined else 1,
+                "donation": bool(donate),
+                "pipelined": bool(pipelined),
+            },
         }
 
     def make_runner(self, max_steps: int = 10_000, mesh=None):
@@ -927,6 +1057,33 @@ class Engine:
             return fn(shard_seeds(seeds, mesh))
 
         return sharded
+
+    def make_stream_runner(
+        self,
+        batch: int = 1024,
+        segment_steps: int = 256,
+        max_steps: int = 10_000,
+        mesh=None,
+        **stream_kwargs,
+    ):
+        """A configured `(n_seeds, seed_start=0) -> run_stream dict`:
+        one place to bind the pipelined-executor knobs (pipelined /
+        segments_per_dispatch / dispatch_depth / donate) so the CLI, the
+        bench harness, and the sharded + multihost paths all inherit the
+        same executor. Pre-warms nothing: the first call compiles."""
+
+        def run(n_seeds: int, seed_start: int = 0):
+            return self.run_stream(
+                n_seeds,
+                batch=batch,
+                segment_steps=segment_steps,
+                seed_start=seed_start,
+                max_steps=max_steps,
+                mesh=mesh,
+                **stream_kwargs,
+            )
+
+        return run
 
     def failing_seeds(self, result: BatchResult) -> jax.Array:
         """Gather the failing lane seeds back to the host

@@ -89,6 +89,43 @@ def global_seeds(n_seeds: int, seed_start: int = 0, mesh=None) -> jax.Array:
     return jax.make_array_from_callback((n_seeds,), seed_sharding(mesh), local_shard)
 
 
+def run_stream_global(
+    engine,
+    n_seeds: int,
+    batch: int = 1024,
+    segment_steps: int = 256,
+    seed_start: int = 0,
+    max_steps: int = 10_000,
+    mesh=None,
+    **stream_kwargs,
+) -> dict:
+    """Seed streaming sharded over the global (all-hosts) mesh: every
+    process runs the identical SPMD pipelined executor — device-side
+    supersegments, donated carry, K-deep dispatch (run_stream kwargs
+    `pipelined` / `segments_per_dispatch` / `dispatch_depth` / `donate`
+    pass through) — and the host loops stay in lockstep because every
+    decision they make reads replicated counters. Only the counters
+    poll and the ring drains cross DCN, each a few hundred bytes, so
+    the steady state is collective-free exactly like the single-host
+    path. Returns run_stream's dict (identical on every process).
+    """
+    mesh = mesh if mesh is not None else global_mesh()
+    axis = mesh.shape[SEED_AXIS]
+    if batch % axis != 0:
+        raise ValueError(
+            f"batch ({batch}) must be a multiple of the global device count ({axis})"
+        )
+    return engine.run_stream(
+        n_seeds,
+        batch=batch,
+        segment_steps=segment_steps,
+        seed_start=seed_start,
+        max_steps=max_steps,
+        mesh=mesh,
+        **stream_kwargs,
+    )
+
+
 def run_batch_global(
     engine,
     n_seeds: int,

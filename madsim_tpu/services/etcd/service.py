@@ -67,12 +67,20 @@ class Event:
 class EtcdService:
     """Reference: service.rs `EtcdService`."""
 
-    def __init__(self, rng, history_limit: int = 10_000):
+    def __init__(self, rng, history_limit: int = 10_000,
+                 lease_expiry_off_by_one: bool = False):
         self.rng = rng
         # watchable-history bound: exceeding it auto-compacts the oldest
         # whole revisions away (a real etcd bounds history by compaction
         # too; without this a write-heavy run leaks one Event per put)
         self.history_limit = history_limit
+        # TEST-ONLY seeded bug for the bidirectional service
+        # differential (tests/test_differential_services.py): the expiry
+        # sweep's revoke loop starts at index 1 — the classic off-by-one
+        # — leaking the first attached key of every EXPIRED lease.
+        # Explicit lease_revoke calls are unaffected. Never set this
+        # outside tests.
+        self.lease_expiry_off_by_one = lease_expiry_off_by_one
         self.revision = 1
         self.kv: Dict[bytes, KeyValue] = {}
         # lease id -> (granted_ttl, remaining_ttl)
@@ -328,6 +336,12 @@ class EtcdService:
             if pair[1] <= 0:
                 expired.append(lease_id)
         for lease_id in expired:
+            if self.lease_expiry_off_by_one:
+                # seeded bug (see __init__): skip the first attached key
+                del self.leases[lease_id]
+                for key in sorted(self.lease_keys.pop(lease_id, set()))[1:]:
+                    self.delete(key)
+                continue
             self.lease_revoke(lease_id)
 
     # -- elections (reference: service.rs:487+, election.rs) --------------------

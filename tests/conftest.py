@@ -42,3 +42,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def pytest_configure(config):
+    # registered in pyproject.toml too; kept here so the marker exists
+    # even when pytest runs with a different rootdir/ini
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running suites (full engine sweeps, soak); excluded "
+        "from the tier-1 fast gate via -m 'not slow'",
+    )

@@ -5,6 +5,10 @@ run seeds batched, verify TPU-reported outcomes replay identically)."""
 import jax
 import jax.numpy as jnp
 import pytest
+# Full engine sweeps are minutes-long: excluded from the tier-1 fast
+# gate (pytest -m "not slow"); run with -m slow or no marker filter.
+pytestmark = pytest.mark.slow
+
 
 from madsim_tpu.engine import (
     Engine,

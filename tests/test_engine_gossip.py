@@ -5,6 +5,10 @@ duplicate-ack counting bug caught at the commit event."""
 
 import jax.numpy as jnp
 import pytest
+# Full engine sweeps are minutes-long: excluded from the tier-1 fast
+# gate (pytest -m "not slow"); run with -m slow or no marker filter.
+pytestmark = pytest.mark.slow
+
 
 from madsim_tpu.engine import Engine, EngineConfig, FaultPlan, replay
 from madsim_tpu.engine.core import F_CLOG_GROUP

@@ -3,6 +3,11 @@ acceptors under chaos; dropping the promise check on ACCEPT (the classic
 implementation bug) gets caught by the ghost chosen-register and
 replays bit-identically."""
 
+import pytest
+# Full engine sweeps are minutes-long: excluded from the tier-1 fast
+# gate (pytest -m "not slow"); run with -m slow or no marker filter.
+pytestmark = pytest.mark.slow
+
 import jax.numpy as jnp
 
 from madsim_tpu.engine import Engine, EngineConfig, FaultPlan, replay
