@@ -118,8 +118,17 @@ import jax  # noqa: E402
 
 
 def main() -> None:
+    import dataclasses
+
+    from madsim_tpu.compile_cache import active_compile_cache, enable_compile_cache
     from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
     from madsim_tpu.models.raft import RaftMachine
+
+    # Persistent compilation cache (opt-in MADSIM_TPU_COMPILE_CACHE=dir):
+    # sweeps and repeated bench captures pay the multi-second streaming
+    # compile once per machine, not once per process. Enabled before any
+    # jit so the warmup compile itself can hit.
+    enable_compile_cache()
 
     # default = the real-chip sweep's max (benches/tpu_sweep.py, r2:
     # 8192x384 -> 2825 seeds/s vs 2214 at the old 4096x192)
@@ -128,6 +137,14 @@ def main() -> None:
     if lanes < 1 or reps < 1:
         sys.exit("usage: bench.py [lanes>=1] [reps>=1]")
     segment_steps = 384
+    # Step-path gates (this PR): counter-based per-event RNG (stream v3)
+    # and bit-packed clog rows, both default-ON for the bench; the fused
+    # Pallas pop+gather engages by backend (TPU). Each is individually
+    # toggleable for A/B attribution (MADSIM_TPU_RNG_STREAM=2,
+    # MADSIM_TPU_CLOG_PACKED=0, MADSIM_TPU_PALLAS_POP=0) and the active
+    # gates land in the output JSON so BENCH_r* files are self-describing.
+    rng_stream = int(os.environ.get("MADSIM_TPU_RNG_STREAM", "3"))
+    clog_packed = os.environ.get("MADSIM_TPU_CLOG_PACKED", "1") not in ("", "0")
     cfg = EngineConfig(
         horizon_us=5_000_000,
         # 32 slots: the real-chip queue sweep (PROFILE_r2.md) — the [L, Q]
@@ -136,6 +153,8 @@ def main() -> None:
         # surface as failing lanes with code 1, never as silent loss)
         queue_capacity=32,
         faults=FaultPlan(n_faults=2, t_max_us=3_000_000, dur_min_us=200_000, dur_max_us=800_000),
+        rng_stream=rng_stream,
+        clog_packed=clog_packed,
     )
     eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
 
@@ -148,10 +167,14 @@ def main() -> None:
         batch=lanes, segment_steps=segment_steps, pipelined=pipelined,
     )
 
-    # Warmup 1: compile the streaming path at the timed batch size.
-    # Warmup 2: a full-size untimed run to bring the chip to a steady
-    # power/clock state (a cold first rep reads 10-20% low).
+    # Warmup 1: compile the streaming path at the timed batch size —
+    # timed separately so the emitted JSON splits one-time compile cost
+    # (compile_s; near-zero on a warm persistent cache) from steady
+    # state. Warmup 2: a full-size untimed run to bring the chip to a
+    # steady power/clock state (a cold first rep reads 10-20% low).
+    t0 = time.perf_counter()
     run(1)
+    compile_s = time.perf_counter() - t0
     run(2 * lanes, seed_start=500_000)
 
     # Timed: `reps` independent repetitions over disjoint seed ranges;
@@ -172,6 +195,36 @@ def main() -> None:
         load1 = round(os.getloadavg()[0], 2)
     except OSError:
         load1 = None
+
+    # Optional per-gate attribution (MADSIM_TPU_BENCH_STEP_COST=1): time
+    # one shorter rep with each step-path gate individually toggled OFF
+    # so the win decomposes instead of arriving as a blob. Costs one
+    # compile + one rep per gate — off by default.
+    step_cost = None
+    if os.environ.get("MADSIM_TPU_BENCH_STEP_COST", "") not in ("", "0"):
+        def one_rate(engine):
+            r = engine.make_stream_runner(
+                batch=lanes, segment_steps=segment_steps, pipelined=pipelined
+            )
+            r(1)
+            t0 = time.perf_counter()
+            out2 = r(2 * lanes, seed_start=3_000_000)
+            return round(out2["completed"] / (time.perf_counter() - t0), 1)
+
+        step_cost = {"all_gates_on": round(seeds_per_sec, 1)}
+        if cfg.rng_stream != 2:
+            step_cost["rng_stream_v2"] = one_rate(
+                Engine(eng.machine, dataclasses.replace(cfg, rng_stream=2))
+            )
+        if cfg.clog_packed:
+            step_cost["clog_unpacked"] = one_rate(
+                Engine(eng.machine, dataclasses.replace(cfg, clog_packed=False))
+            )
+        if eng.use_pallas_pop:
+            step_cost["pallas_pop_off"] = one_rate(
+                Engine(eng.machine, cfg, use_pallas_pop=False)
+            )
+
     print(
         json.dumps(
             {
@@ -181,6 +234,19 @@ def main() -> None:
                 "vs_baseline": round(seeds_per_sec / per_chip_target, 3),
                 "platform": jax.devices()[0].platform,
                 "backend": _BACKEND_INFO,
+                # one-time compile vs steady state, split (a cold process
+                # pays compile_s once; with MADSIM_TPU_COMPILE_CACHE set
+                # it drops to cache-load time on the second process)
+                "compile_s": round(compile_s, 2),
+                "steady_seeds_per_sec": round(seeds_per_sec, 1),
+                # active step-path gates: BENCH_r* files stay
+                # self-describing across this PR's flags
+                "gates": {
+                    "rng_stream": cfg.rng_stream,
+                    "clog_packed": cfg.clog_packed,
+                    "pallas_pop": eng.use_pallas_pop,
+                    "compile_cache": active_compile_cache(),
+                },
                 "diagnostics": {
                     "reps": [round(x, 1) for x in rates],
                     "min": round(min(rates), 1),
@@ -198,6 +264,7 @@ def main() -> None:
                     "segments_per_dispatch": stream_stats["segments_per_dispatch"],
                     "donation": stream_stats["donation"],
                     "pipelined": stream_stats["pipelined"],
+                    **({"step_cost": step_cost} if step_cost else {}),
                 },
             }
         )

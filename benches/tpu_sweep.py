@@ -5,7 +5,8 @@ workload and prints one JSON line per point. Run:
 
     python benches/tpu_sweep.py                # default grid
     python benches/tpu_sweep.py 8192 192       # single point
-    MADSIM_TPU_PALLAS_POP=1 python benches/tpu_sweep.py 8192 192
+    MADSIM_TPU_PALLAS_POP=0 python benches/tpu_sweep.py 8192 192   # A/B: XLA pop
+    MADSIM_TPU_RNG_STREAM=2 MADSIM_TPU_CLOG_PACKED=0 ...           # A/B: legacy step path
 
 The timed region matches bench.py (3*batch seeds streamed, warmed up).
 """
@@ -31,6 +32,9 @@ def run_point(batch: int, segment_steps: int) -> dict:
         horizon_us=5_000_000,
         queue_capacity=96,
         faults=FaultPlan(n_faults=2, t_max_us=3_000_000, dur_min_us=200_000, dur_max_us=800_000),
+        # step-path gates (same env overrides as bench.py; defaults = on)
+        rng_stream=int(os.environ.get("MADSIM_TPU_RNG_STREAM", "3")),
+        clog_packed=os.environ.get("MADSIM_TPU_CLOG_PACKED", "1") not in ("", "0"),
     )
     eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
     # pipelined-executor knobs (round-6), env-tunable for A/B sweeps:
@@ -52,7 +56,10 @@ def run_point(batch: int, segment_steps: int) -> dict:
     return {
         "batch": batch,
         "segment_steps": segment_steps,
-        "pallas_pop": os.environ.get("MADSIM_TPU_PALLAS_POP", "0"),
+        # resolved gate, not the env echo: pallas defaults ON on TPU now
+        "pallas_pop": eng.use_pallas_pop,
+        "rng_stream": cfg.rng_stream,
+        "clog_packed": cfg.clog_packed,
         "seeds_per_sec": round(out["completed"] / elapsed, 1),
         "completed": out["completed"],
         "elapsed_s": round(elapsed, 2),

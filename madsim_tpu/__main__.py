@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 
 
@@ -125,6 +126,8 @@ def _build_engine(args):
         horizon_us=round(args.horizon * 1e6),
         queue_capacity=args.queue,
         packet_loss_rate=args.loss,
+        rng_stream=getattr(args, "rng_stream", 2),
+        compile_cache_dir=getattr(args, "compile_cache", None),
         faults=FaultPlan(
             n_faults=args.faults,
             # explicit --fault-tmax keeps fault draws stable when a shrunk
@@ -167,6 +170,7 @@ def _repro_line(args, seed) -> str:
         f"--queue {args.queue} --faults {args.faults} --loss {args.loss} "
         f"--fault-tmax {tmax} "
         f"--fault-kinds {getattr(args, 'fault_kinds', 'pair,kill')} "
+        f"--rng-stream {getattr(args, 'rng_stream', 2)} "
         f"--max-steps {args.max_steps}"
     )
 
@@ -451,7 +455,8 @@ def cmd_shrink(args) -> int:
         f"--horizon {sr.shrunk.horizon_us / 1e6} --queue {sr.shrunk.queue_capacity} "
         f"--faults {f.n_faults} --fault-tmax {f.t_max_us} "
         f"--loss {sr.shrunk.packet_loss_rate} --max-steps {sr.steps} "
-        f"--fault-kinds {getattr(args, 'fault_kinds', 'pair,kill')}"
+        f"--fault-kinds {getattr(args, 'fault_kinds', 'pair,kill')} "
+        f"--rng-stream {sr.shrunk.rng_stream}"
     )
     return 0
 
@@ -649,6 +654,19 @@ def main(argv=None) -> int:
             help="comma list of fault kinds to draw from: "
             "pair,kill,dir,group,storm,delay (default pair,kill; any "
             "other kind switches to the v2 schedule derivation)",
+        )
+        p.add_argument(
+            "--rng-stream", type=int, default=2, choices=(2, 3),
+            help="per-step RNG stream version: 2 = legacy split-chain "
+            "(default; replays every recorded seed), 3 = counter-based "
+            "(one threefry per event — faster; new hunts should use it; "
+            "corpus entries record the version either way)",
+        )
+        p.add_argument(
+            "--compile-cache", default=os.environ.get("MADSIM_TPU_COMPILE_CACHE"),
+            help="JAX persistent compilation cache directory (also "
+            "$MADSIM_TPU_COMPILE_CACHE): pay each compile once per "
+            "machine, not once per process",
         )
 
     def stream_flags(p):

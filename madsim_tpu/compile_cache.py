@@ -1,0 +1,65 @@
+"""Opt-in JAX persistent compilation cache wiring.
+
+PROFILE_r5 measured multi-second `lane_step` / streaming-executor
+recompiles paid once per *process*; hunts, sweeps and CI shards spawn
+many processes over the same configs, so they should pay each compile
+once per *machine*. Enabling is one env var (or `EngineConfig` /
+`--compile-cache`):
+
+    MADSIM_TPU_COMPILE_CACHE=~/.cache/madsim_tpu python -m madsim_tpu ...
+
+The cache is keyed by (HLO, jaxlib version, XLA flags, device kind), so
+it is safe to share a directory across configs and machines of the same
+software image; a mismatched key is simply a miss. Works on CPU, GPU and
+TPU backends with current jaxlib.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_active_dir: Optional[str] = None
+
+
+def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Enable the JAX persistent compilation cache.
+
+    `path` falls back to $MADSIM_TPU_COMPILE_CACHE; with neither set
+    this is a no-op returning None. Idempotent — the first directory
+    wins for the process (jax's cache is global); later calls with a
+    different directory return the ACTIVE one rather than silently
+    rebinding half the jit cache. Returns the active directory."""
+    global _active_dir
+    path = path or os.environ.get("MADSIM_TPU_COMPILE_CACHE")
+    if not path:
+        return _active_dir
+    path = os.path.abspath(os.path.expanduser(path))
+    if _active_dir is not None:
+        return _active_dir
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every compile, not just the multi-second ones: a hunt's many
+    # small jits (replay steps, shrink candidates) add up too. -1 on the
+    # entry-size floor disables the filesystem-specific override that 0
+    # would allow (which can silently skip small entries).
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # the cache module latches "no cache" on the first compile of the
+    # process; a reset makes the next compile re-initialize against the
+    # directory just configured (no-op if nothing compiled yet)
+    try:
+        from jax.experimental.compilation_cache import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - layout drift across jax versions
+        pass
+    _active_dir = path
+    return _active_dir
+
+
+def active_compile_cache() -> Optional[str]:
+    """The directory enabled for this process, or None."""
+    return _active_dir

@@ -30,6 +30,9 @@ STATUS_FIXED = "fixed"  # bug resolved: entry must keep passing
 
 def config_to_dict(cfg: EngineConfig) -> dict:
     d = dataclasses.asdict(cfg)
+    # host-side knob, never trace-affecting: a corpus entry must replay
+    # on any machine, not name some other box's cache directory
+    d.pop("compile_cache_dir", None)
     return d
 
 

@@ -137,7 +137,8 @@ def _trace_affecting_key(engine: Engine) -> tuple:
         cfg.packet_loss_rate,
         cfg.handler_rand_words,
         cfg.trace_ring,
-        cfg.faults.allow_delay,  # changes the per-step RNG word count
+        cfg.clog_packed,
+        engine._rng_layout,  # stream version + word-block layout
         engine.use_pallas_pop,
     )
 

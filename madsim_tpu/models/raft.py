@@ -436,12 +436,19 @@ class RaftMachine(Machine):
         both_lead = is_lead[:, None] & is_lead[None, :] & ~jnp.eye(n, dtype=bool)
         elec_viol = jnp.any(both_lead & same_term)
 
-        # committed prefixes must agree pairwise
+        # Committed prefixes must agree pairwise. Checked per POSITION
+        # instead of per pair — O(N*CAP), not O(N^2*CAP), and exactly
+        # equivalent: nodes i, j disagree at a position k both have
+        # committed iff, among the nodes whose commit reaches k, the
+        # min and max log term at k differ (empty/singleton sets give
+        # min >= max, never a violation). The invariant runs EVERY
+        # event on every lane, so this is hot-path arithmetic.
         idxs = jnp.arange(self.log_capacity + 1, dtype=jnp.int32)
-        upto = jnp.minimum(nodes.commit[:, None], nodes.commit[None, :])  # [N,N]
-        in_prefix = (idxs[None, None, :] >= 1) & (idxs[None, None, :] <= upto[:, :, None])
-        differs = nodes.log_term[:, None, :] != nodes.log_term[None, :, :]
-        log_viol = jnp.any(in_prefix & differs)
+        committed = (idxs[None, :] >= 1) & (idxs[None, :] <= nodes.commit[:, None])
+        big = jnp.int32(2**31 - 1)
+        t_min = jnp.min(jnp.where(committed, nodes.log_term, big), axis=0)
+        t_max = jnp.max(jnp.where(committed, nodes.log_term, -big), axis=0)
+        log_viol = jnp.any(t_max > t_min)
 
         ok = ~(elec_viol | log_viol)
         code = jnp.where(elec_viol, ELECTION_SAFETY, jnp.where(log_viol, LOG_MATCHING, 0))

@@ -1,18 +1,32 @@
-"""Pallas TPU kernel for the batched event-queue pop.
+"""Pallas TPU kernels for the batched event-queue pop (+ fused gather).
 
 `pop_earliest` is the per-step hot op of the TPU engine: a lexicographic
 (time, seq) argmin over each lane's Q event slots. The XLA lowering is
-three masked reductions; this Pallas version fuses them into one VMEM
+three masked reductions; the Pallas versions fuse them into one VMEM
 pass per lane block so the slot arrays are read once
 (guide: /opt/skills/guides/pallas_guide.md — int32 min tile 8x128, lane
 axis = slots).
 
-Everything is min-reductions over the lane axis (argmin is expressed as
-min over an index encoding) — no gathers, no cross-lane shuffles, so the
-kernel lowers cleanly on Mosaic. Until real-chip profiles justify
-flipping the default, the engine keeps the XLA path; this kernel is
-validated against it bit-for-bit in interpreter mode
-(tests/test_pallas.py) and via `pop_earliest_batch(..., use_pallas=True)`.
+Two kernels:
+
+  * `_pop_kernel` — pop only: (idx, any_valid). The original r4 kernel.
+  * `_pop_gather_kernel` — pop + the 5 follow-up gathers the step does
+    with the result (`eq_time[idx]`, kind, node, src, payload[idx]) in
+    the SAME VMEM pass, so the popped event tuple leaves the kernel and
+    the per-lane XLA gathers disappear from the step. Payload columns
+    ride as separate [L, Q] operands (restacked after the call) so every
+    block stays rank-2 — Mosaic-friendly, no 3-D tiling games.
+
+Everything is min-reductions and one-hot sums over the lane axis (argmin
+is expressed as min over an index encoding; gather as a one-hot masked
+sum, exact for int32) — no real gathers, no cross-lane shuffles, so the
+kernels lower cleanly on Mosaic.
+
+The engine flips the fused kernel default-ON when the backend is TPU
+(`Engine.use_pallas_pop`; `MADSIM_TPU_PALLAS_POP=0/1` forces either
+way). The vmapped XLA path remains the fallback and the bit-identity
+oracle: both paths are asserted equal in interpreter mode for queue
+capacities {32, 64} and payload widths {4, 6} (tests/test_pallas.py).
 """
 
 from __future__ import annotations
@@ -34,16 +48,14 @@ except Exception:  # pragma: no cover
 LANE_BLOCK = 8  # lanes per grid step (int32 sublane tile)
 
 
-def _pop_kernel(time_ref, seq_ref, valid_ref, idx_ref, any_ref):
-    """One grid step: LANE_BLOCK lanes x Q slots, fused lexicographic argmin."""
-    t = time_ref[...]
-    s = seq_ref[...]
-    v = valid_ref[...] != 0
+def _lex_argmin(t, s, v):
+    """Fused lexicographic argmin over the minor axis; shared by both
+    kernels. Returns (idx[., 1], any[., 1] int32) with idx=0 for
+    all-invalid rows (matching jnp.argmin over an all-sentinel row)."""
     q = t.shape[-1]
     # create the sentinel inside the kernel trace (module-level jnp
     # constants would be captured, which pallas_call rejects)
     big = jnp.int32(2**31 - 1)
-
     t_masked = jnp.where(v, t, big)
     tmin = jnp.min(t_masked, axis=-1, keepdims=True)
     tie = v & (t == tmin)
@@ -53,10 +65,56 @@ def _pop_kernel(time_ref, seq_ref, valid_ref, idx_ref, any_ref):
     cols = jax.lax.broadcasted_iota(jnp.int32, t.shape, dimension=t.ndim - 1)
     idx_enc = jnp.where(tie & (s == smin), cols, jnp.int32(q))
     idx = jnp.min(idx_enc, axis=-1, keepdims=True)
+    idx = jnp.where(idx == q, 0, idx)
+    any_v = jnp.any(v, axis=-1, keepdims=True).astype(jnp.int32)
+    return idx, any_v, cols
+
+
+def _pop_kernel(time_ref, seq_ref, valid_ref, idx_ref, any_ref):
+    """One grid step: LANE_BLOCK lanes x Q slots, pop only."""
+    t = time_ref[...]
+    s = seq_ref[...]
+    v = valid_ref[...] != 0
+    idx, any_v, _ = _lex_argmin(t, s, v)
     # outputs are [LANE_BLOCK, 1]: Mosaic requires rank-1 block shapes to
     # be 128-multiples, so the lane-per-row result keeps a unit minor dim
-    idx_ref[...] = jnp.where(idx == q, 0, idx)
-    any_ref[...] = jnp.any(v, axis=-1, keepdims=True).astype(jnp.int32)
+    idx_ref[...] = idx
+    any_ref[...] = any_v
+
+
+def _make_pop_gather_kernel(n_vals: int):
+    """Kernel popping + gathering `n_vals` extra [LB, Q] value planes
+    (kind, node, src, payload columns) at the popped slot."""
+
+    def kernel(*refs):
+        time_ref, seq_ref, valid_ref = refs[:3]
+        val_refs = refs[3 : 3 + n_vals]
+        idx_ref, any_ref, time_out = refs[3 + n_vals : 6 + n_vals]
+        val_outs = refs[6 + n_vals :]
+        t = time_ref[...]
+        s = seq_ref[...]
+        v = valid_ref[...] != 0
+        idx, any_v, cols = _lex_argmin(t, s, v)
+        idx_ref[...] = idx
+        any_ref[...] = any_v
+        # gather-at-idx as a one-hot masked sum: exactly one column
+        # matches (idx is always in [0, Q)), so the sum IS the element —
+        # exact for int32, negatives included
+        sel = cols == idx
+        time_out[...] = jnp.sum(jnp.where(sel, t, 0), axis=-1, keepdims=True)
+        for ref, out in zip(val_refs, val_outs):
+            out[...] = jnp.sum(jnp.where(sel, ref[...], 0), axis=-1, keepdims=True)
+
+    return kernel
+
+
+def _pad_lanes(arrs, lanes, q):
+    pad = (-lanes) % LANE_BLOCK
+    if not pad:
+        return arrs, lanes
+    return [
+        jnp.concatenate([a, jnp.zeros((pad, q), a.dtype)]) for a in arrs
+    ], lanes + pad
 
 
 def pop_earliest_pallas(eq_time, eq_seq, eq_valid, interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
@@ -68,12 +126,9 @@ def pop_earliest_pallas(eq_time, eq_seq, eq_valid, interpret: bool = False) -> T
     Non-multiple-of-8 lane counts are padded with invalid rows and the
     outputs sliced back, so both paths accept arbitrary L."""
     lanes, q = eq_time.shape
-    pad = (-lanes) % LANE_BLOCK
-    if pad:
-        eq_time = jnp.concatenate([eq_time, jnp.zeros((pad, q), eq_time.dtype)])
-        eq_seq = jnp.concatenate([eq_seq, jnp.zeros((pad, q), eq_seq.dtype)])
-        eq_valid = jnp.concatenate([eq_valid, jnp.zeros((pad, q), bool)])
-    padded = lanes + pad
+    (eq_time, eq_seq, eq_valid), padded = _pad_lanes(
+        [eq_time, eq_seq, eq_valid.astype(jnp.int32)], lanes, q
+    )
     grid = (padded // LANE_BLOCK,)
     row_spec = pl.BlockSpec((LANE_BLOCK, q), lambda i: (i, 0))
     out_spec = pl.BlockSpec((LANE_BLOCK, 1), lambda i: (i, 0))
@@ -87,8 +142,43 @@ def pop_earliest_pallas(eq_time, eq_seq, eq_valid, interpret: bool = False) -> T
             jax.ShapeDtypeStruct((padded, 1), jnp.int32),
         ],
         interpret=interpret,
-    )(eq_time, eq_seq, eq_valid.astype(jnp.int32))
+    )(eq_time, eq_seq, eq_valid)
     return idx[:lanes, 0], any_valid[:lanes, 0] != 0
+
+
+def pop_gather_pallas(
+    eq_time, eq_seq, eq_valid, eq_kind, eq_node, eq_src, eq_payload,
+    interpret: bool = False,
+):
+    """Fused pop + gather over [L, Q] (+ payload [L, Q, P]) arrays.
+
+    Returns (idx[L], any_valid[L] bool, (time[L], kind[L], node[L],
+    src[L], payload[L, P])) — the popped event tuple, bit-identical to
+    the XLA path's `arr[lane, idx[lane]]` gathers (all-invalid lanes
+    gather slot 0 on both paths)."""
+    lanes, q = eq_time.shape
+    p = eq_payload.shape[-1]
+    vals = [eq_kind, eq_node, eq_src] + [eq_payload[:, :, j] for j in range(p)]
+    ins, padded = _pad_lanes(
+        [eq_time, eq_seq, eq_valid.astype(jnp.int32)] + vals, lanes, q
+    )
+    grid = (padded // LANE_BLOCK,)
+    row_spec = pl.BlockSpec((LANE_BLOCK, q), lambda i: (i, 0))
+    out_spec = pl.BlockSpec((LANE_BLOCK, 1), lambda i: (i, 0))
+    n_vals = len(vals)
+    n_out = 3 + n_vals  # idx, any, time, then the value planes
+    outs = pl.pallas_call(
+        _make_pop_gather_kernel(n_vals),
+        grid=grid,
+        in_specs=[row_spec] * (3 + n_vals),
+        out_specs=[out_spec] * n_out,
+        out_shape=[jax.ShapeDtypeStruct((padded, 1), jnp.int32)] * n_out,
+        interpret=interpret,
+    )(*ins)
+    outs = [o[:lanes, 0] for o in outs]
+    idx, any_valid, ev_time, ev_kind, ev_node, ev_src = outs[:6]
+    ev_payload = jnp.stack(outs[6:], axis=-1)
+    return idx, any_valid != 0, (ev_time, ev_kind, ev_node, ev_src, ev_payload)
 
 
 def pop_earliest_batch(eq_time, eq_seq, eq_valid, use_pallas: bool = False, interpret: bool = False):
@@ -96,3 +186,29 @@ def pop_earliest_batch(eq_time, eq_seq, eq_valid, use_pallas: bool = False, inte
     if use_pallas and HAVE_PALLAS:
         return pop_earliest_pallas(eq_time, eq_seq, eq_valid, interpret=interpret)
     return jax.vmap(pop_earliest)(eq_time, eq_seq, eq_valid)
+
+
+def pop_gather_batch(
+    eq_time, eq_seq, eq_valid, eq_kind, eq_node, eq_src, eq_payload,
+    use_pallas: bool = False, interpret: bool = False,
+):
+    """Pop + gather the popped event tuple: the fused Pallas kernel, or
+    the vmapped-XLA reference (pop + take_along_axis gathers). Both
+    return (idx, any_valid, (time, kind, node, src, payload)) with
+    bit-identical values."""
+    if use_pallas and HAVE_PALLAS:
+        return pop_gather_pallas(
+            eq_time, eq_seq, eq_valid, eq_kind, eq_node, eq_src, eq_payload,
+            interpret=interpret,
+        )
+    idx, any_valid = jax.vmap(pop_earliest)(eq_time, eq_seq, eq_valid)
+
+    def take(a):
+        return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+    ev_payload = jnp.take_along_axis(
+        eq_payload, idx[:, None, None], axis=1
+    )[:, 0, :]
+    return idx, any_valid, (
+        take(eq_time), take(eq_kind), take(eq_node), take(eq_src), ev_payload
+    )

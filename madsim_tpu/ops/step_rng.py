@@ -1,0 +1,185 @@
+"""Per-step RNG word derivation — the versioned stream contract.
+
+Every event step consumes a block of uint32 words: handler randomness,
+per-message latency draws, and (config-permitting) loss, delay-spike and
+restart-key draws. Two stream versions exist; an engine's
+`EngineConfig.rng_stream` picks one, and corpus entries record it so
+every historical seed replays byte-identically forever (the same
+versioning discipline as the v1→v2 fault-plan derivation in
+`core.init_lane`).
+
+**v2 (legacy, split-chain)** — the seed-era stream. The lane key evolves
+by a 3-way `jax.random.split` every step and the block is drawn from the
+step key:
+
+    key, k_step, k_restart = split(rng_key, 3)
+    words = random.bits(k_step, (W2,))        # W2 = H + (4 if delay else 2)*M
+
+Two threefry invocations per event, and the block always carries
+`2*M` latency+drop words (plus `2*M` spike words when `allow_delay`)
+whether or not the config can ever use them.
+
+**v3 (counter-based)** — one threefry invocation per event, Random123
+style: the lane key is immutable and the step index IS the counter
+(`LaneState.step`, already carried for termination):
+
+    words(lane_key, step) = threefry2x32(lane_key, step*W3 + iota(W3))
+
+`W3` is sized to what the enabled config can actually consume — drop
+words only when loss is statically possible, spike words only when
+delay-spike windows are statically reachable, a 2-word restart key only
+when kill/restart faults are enabled. Counters are unique as long as
+`step * W3 < 2**32` (~300M events/lane at W3=14 — far past any
+`max_steps` in use; uniqueness degrades gracefully to reuse, never to
+nondeterminism). Because `jax.random.bits(key, (n,)) ==
+threefry2x32(key, iota(n))`, v3 is the natural counter-offset
+generalization of the v2 block draw.
+
+Both versions share the same block layout (`StepRngLayout`):
+
+    [ handler H | latency M | drop M? | spike M? | spike_mag M? | restart 2? ]
+
+v2 always materializes the drop (and, under `allow_delay`, spike)
+sections; v3 omits statically-dead sections entirely. The engine
+additionally elides the *compute* that consumes a section when it is
+statically inert (e.g. loss_rate==0 and no storms ⇒ the drop compare
+always yields False) — that elision is result-preserving in both
+versions and is independent of the stream contract.
+
+Golden word streams for both versions are pinned as literal constants in
+tests/test_golden_streams.py; any change to the functions below that
+disturbs a pinned stream is a corpus-breaking event and must ship as a
+new version instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:  # jax >= 0.4.16
+    from jax.extend.random import threefry_2x32
+except Exception:  # pragma: no cover - older jax layouts
+    from jax._src.prng import threefry_2x32  # type: ignore
+
+RNG_STREAM_LEGACY = 2
+RNG_STREAM_COUNTER = 3
+RNG_STREAM_VERSIONS = (RNG_STREAM_LEGACY, RNG_STREAM_COUNTER)
+
+
+@dataclasses.dataclass(frozen=True)
+class StepRngLayout:
+    """Static word-block layout for one (config, machine) pair.
+
+    Offsets are None when the section is not materialized in this
+    stream. `loss_active` / `spike_active` are the compute-elision
+    flags: a section can be materialized (v2 draws it unconditionally)
+    yet statically inert."""
+
+    version: int
+    handler_words: int
+    max_msgs: int
+    lat_off: int
+    drop_off: Optional[int]
+    spike_off: Optional[int]  # gate words; magnitude words follow at +max_msgs
+    restart_off: Optional[int]  # v3 only; v2 takes k_restart from the split
+    total_words: int
+    loss_active: bool
+    spike_active: bool
+    restart_active: bool
+
+
+def layout_for(
+    version: int,
+    handler_words: int,
+    max_msgs: int,
+    *,
+    loss_possible: bool,
+    spike_possible: bool,
+    delay_enabled: bool,
+    restart_possible: bool,
+) -> StepRngLayout:
+    """Build the block layout. `delay_enabled` is the raw
+    `FaultPlan.allow_delay` flag (v2 materializes spike words on it
+    alone); `spike_possible` additionally requires n_faults > 0."""
+    h, m = handler_words, max_msgs
+    if version == RNG_STREAM_LEGACY:
+        return StepRngLayout(
+            version=version,
+            handler_words=h,
+            max_msgs=m,
+            lat_off=h,
+            drop_off=h + m,
+            spike_off=h + 2 * m if delay_enabled else None,
+            restart_off=None,
+            total_words=h + (4 if delay_enabled else 2) * m,
+            loss_active=loss_possible,
+            spike_active=delay_enabled and spike_possible,
+            restart_active=restart_possible,
+        )
+    if version != RNG_STREAM_COUNTER:
+        raise ValueError(f"unknown rng_stream version {version!r}")
+    cursor = h + m
+    drop_off = None
+    if loss_possible:
+        drop_off = cursor
+        cursor += m
+    spike_off = None
+    if spike_possible:
+        spike_off = cursor
+        cursor += 2 * m
+    restart_off = None
+    if restart_possible:
+        restart_off = cursor
+        cursor += 2
+    return StepRngLayout(
+        version=version,
+        handler_words=h,
+        max_msgs=m,
+        lat_off=h,
+        drop_off=drop_off,
+        spike_off=spike_off,
+        restart_off=restart_off,
+        total_words=cursor,
+        loss_active=loss_possible,
+        spike_active=spike_possible,
+        restart_active=restart_possible,
+    )
+
+
+def step_words_v2(rng_key: jax.Array, layout: StepRngLayout) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Legacy split-chain step draw.
+
+    Returns (new_key, words[total_words], k_restart). The restart key is
+    its own split — never derived from a consumed key (stream-collision
+    hazard)."""
+    key, k_step, k_restart = jax.random.split(rng_key, 3)
+    words = jax.random.bits(k_step, (layout.total_words,), jnp.uint32)
+    return key, words, k_restart
+
+
+def step_words_v3(rng_key: jax.Array, step: jax.Array, layout: StepRngLayout) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Counter-based step draw: one threefry invocation per event.
+
+    Returns (new_key, words[total_words], k_restart); new_key is the
+    UNCHANGED lane key (immutable by contract). The restart key, when
+    materialized, is the block's trailing 2 words."""
+    w = layout.total_words
+    counts = step.astype(jnp.uint32) * jnp.uint32(w) + jnp.arange(w, dtype=jnp.uint32)
+    words = threefry_2x32(rng_key, counts)
+    if layout.restart_off is not None:
+        k_restart = words[layout.restart_off : layout.restart_off + 2]
+    else:
+        # restart statically unreachable: the key value is dead (the
+        # restart write is masked off), any constant works
+        k_restart = jnp.zeros((2,), jnp.uint32)
+    return rng_key, words, k_restart
+
+
+def step_words(rng_key: jax.Array, step: jax.Array, layout: StepRngLayout):
+    if layout.version == RNG_STREAM_COUNTER:
+        return step_words_v3(rng_key, step, layout)
+    return step_words_v2(rng_key, layout)

@@ -2,6 +2,8 @@
 replay, seed sharding (the §7 step-4 'minimum end-to-end slice' bar:
 run seeds batched, verify TPU-reported outcomes replay identically)."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import pytest
@@ -562,10 +564,16 @@ def test_fault_kind_coverage_all_kinds_scheduled():
 
 def test_directional_clog_blocks_one_way_only():
     """clogged[a, b] drops a->b sends while b->a still delivers (the
-    matrix was always directional; the new fault kind exposes it)."""
+    matrix was always directional; the new fault kind exposes it).
+    Pokes the bool-matrix representation directly, so it pins
+    clog_packed=False — the packed rows are asserted bit-identical to
+    this oracle in tests/test_step_gates.py."""
     from madsim_tpu.models.echo import CLIENT, SERVER
 
-    eng = Engine(EchoMachine(rounds=3, retry_us=50_000), EngineConfig(queue_capacity=32))
+    eng = Engine(
+        EchoMachine(rounds=3, retry_us=50_000),
+        EngineConfig(queue_capacity=32, clog_packed=False),
+    )
 
     def run_with_clog(src, dst):
         state = eng.init_batch(jnp.zeros((1,), jnp.uint32))
@@ -585,10 +593,15 @@ def test_directional_clog_blocks_one_way_only():
 
 def test_loss_storm_drops_then_recovers():
     """A full-rate storm stops delivery; clearing it lets retries finish
-    the workload."""
+    the workload. Injects storm_loss by hand, which bypasses the fault
+    schedule — the config must declare storms reachable (allow_storm),
+    or the engine statically elides the loss compute for this config."""
     eng = Engine(
         EchoMachine(rounds=3, retry_us=50_000),
-        EngineConfig(horizon_us=60_000_000, queue_capacity=32),
+        EngineConfig(
+            horizon_us=60_000_000, queue_capacity=32,
+            faults=FaultPlan(n_faults=0, allow_storm=True),
+        ),
     )
     state = eng.init_batch(jnp.zeros((1,), jnp.uint32))
     state = state.replace(storm_loss=jnp.full((1,), 65535, jnp.int32))
@@ -626,7 +639,9 @@ def test_group_partition_clogs_exactly_cross_links():
         def is_done(self, nodes, now_us):
             return jnp.bool_(False)
 
-    eng = Engine(NeverDoneRaft(5, 8), cfg)
+    # white-box matrix assertions: pin the bool-matrix oracle (packed
+    # rows are asserted bit-identical in tests/test_step_gates.py)
+    eng = Engine(NeverDoneRaft(5, 8), dataclasses.replace(cfg, clog_packed=False))
 
     seen = {"apply": 0, "heal": 0}
 

@@ -1,0 +1,205 @@
+"""Golden RNG word streams + fault schedules, pinned as literal constants.
+
+Behavioral replay (corpus regress, pinned-seed tests) guards legacy
+seeds indirectly; these constants guard them DIRECTLY: the v2 step-word
+stream, the v1/v2 fault-schedule derivations, and the v3 counter stream
+are each pinned bit-for-bit. If any engine change disturbs a pinned
+stream, this file fails before a single corpus entry gets a chance to
+drift — the rng_stream=3 gate (and anything after it) provably cannot
+touch the legacy streams.
+
+The v1/v2 constants were captured from the pre-v3 engine (PR-1 HEAD,
+e0405fb); the v3 constants pin the NEW stream so it too is frozen from
+birth. A deliberate stream change must ship as a new version, never as
+an edit to these numbers.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
+from madsim_tpu.models.raft import RaftMachine
+from madsim_tpu.ops.step_rng import (
+    RNG_STREAM_COUNTER,
+    RNG_STREAM_LEGACY,
+    layout_for,
+    step_words,
+    step_words_v3,
+)
+
+# --- pinned constants ------------------------------------------------------
+
+# v2 step words: handler_rand_words=4, MAX_MSGS=4, allow_delay off
+# => 12-word block; key chain PRNGKey(seed) -> split(3) -> per-step
+# split(3)+bits. Captured at PR-1 HEAD.
+V2_WORDS = {
+    7: [
+        [4214792054, 1260227468, 1640883124, 2425832054, 3605214257, 3166382466,
+         3927872912, 2408175273, 2750083161, 428900463, 4137107995, 3015843103],
+        [3333476539, 4045693078, 1033620173, 3623907546, 1060330335, 1712605834,
+         3849462251, 3304002638, 3770916476, 933675449, 906760448, 2718080322],
+    ],
+    123: [
+        [2496579800, 651695700, 3729129202, 375214000, 2025909036, 2774168915,
+         3670720520, 207514721, 4233063012, 4123477057, 402553556, 2553420927],
+        [1885868696, 2996385906, 1588223244, 3457262576, 796519027, 1918105540,
+         2147996441, 1958354035, 2654864958, 203416391, 2373135289, 2173715111],
+    ],
+}
+V2_K_RESTART = {
+    7: [[2619868301, 2210700558], [2304019816, 3891442957]],
+    123: [[3458513999, 889850992], [64212938, 1747517915]],
+}
+
+# Fault schedules for RaftMachine(5), queue_capacity=32,
+# FaultPlan(n_faults=2, t_max_us=3_000_000, dur 200_000..800_000):
+# event-queue rows [5, 9) of init_lane. Captured at PR-1 HEAD.
+V1_FAULTS = FaultPlan(n_faults=2, t_max_us=3_000_000, dur_min_us=200_000, dur_max_us=800_000)
+V2_FAULTS = dataclasses.replace(
+    V1_FAULTS, allow_dir_clog=True, allow_group=True, allow_storm=True
+)
+V1_SCHED = {
+    7: {
+        "time": [1292254, 1837024, 2350629, 2928601],
+        "seq": [5, 6, 7, 8],
+        "node": [1, 1, 4, 4],
+        "pay": [[0, 1, 0, 0, 0, 0], [1, 1, 0, 0, 0, 0],
+                [2, 4, 0, 0, 0, 0], [3, 4, 0, 0, 0, 0]],
+    },
+    123: {
+        "time": [66839, 444569, 858186, 1220446],
+        "seq": [5, 6, 7, 8],
+        "node": [2, 2, 4, 4],
+        "pay": [[0, 2, 1, 0, 0, 0], [1, 2, 1, 0, 0, 0],
+                [2, 4, 2, 0, 0, 0], [3, 4, 2, 0, 0, 0]],
+    },
+}
+V2_SCHED = {
+    7: {
+        "time": [164039, 689732, 1502478, 1794064],
+        "seq": [5, 6, 7, 8],
+        "node": [0, 0, 4, 4],
+        "pay": [[0, 0, 3, 0, 0, 0], [1, 0, 3, 0, 0, 0],
+                [6, 3, 0, 0, 0, 0], [7, 3, 0, 0, 0, 0]],
+    },
+    123: {
+        "time": [477089, 1179448, 2611921, 3379818],
+        "seq": [5, 6, 7, 8],
+        "node": [0, 0, 4, 4],
+        "pay": [[4, 0, 3, 0, 0, 0], [5, 0, 3, 0, 0, 0],
+                [6, 3, 0, 0, 0, 0], [7, 3, 0, 0, 0, 0]],
+    },
+}
+
+# v3 counter stream: same (4, 4, no-delay) config with kill enabled
+# => 10-word block [handler 4 | lat 4 | restart 2];
+# words(key, step) = threefry2x32(key, step*10 + iota(10)).
+# Pinned at introduction (this PR) — frozen from birth.
+V3_WORDS = {
+    7: [
+        [469979567, 2630006822, 107867572, 521628325, 4058801364, 1224679957,
+         1947713326, 2661010368, 2099174757, 959740060],
+        [2393826230, 2916538718, 3536995759, 408775398, 3962656131, 2262925636,
+         1042797824, 2692833174, 3110079748, 3680617232],
+    ],
+    123: [
+        [246548333, 331794331, 1710157904, 2746974178, 1470315740, 1879015273,
+         2684591198, 426354133, 1276734953, 972702624],
+        [3348752618, 3527090588, 2755500065, 3401051675, 1043462902, 2104391751,
+         163158707, 1090829266, 2278769389, 440881726],
+    ],
+}
+
+
+def _lane_key(seed):
+    key = jax.random.PRNGKey(seed)
+    key, _k_init, _k_faults = jax.random.split(key, 3)
+    return key
+
+
+def _v2_layout():
+    return layout_for(
+        RNG_STREAM_LEGACY, 4, 4,
+        loss_possible=False, spike_possible=False, delay_enabled=False,
+        restart_possible=True,
+    )
+
+
+def _v3_layout():
+    return layout_for(
+        RNG_STREAM_COUNTER, 4, 4,
+        loss_possible=False, spike_possible=False, delay_enabled=False,
+        restart_possible=True,
+    )
+
+
+def test_v2_step_words_pinned():
+    layout = _v2_layout()
+    assert layout.total_words == 12
+    for seed, expect in V2_WORDS.items():
+        key = _lane_key(seed)
+        for step in range(2):
+            key, words, k_restart = step_words(key, jnp.int32(step), layout)
+            assert words.tolist() == expect[step], (seed, step)
+            assert k_restart.tolist() == V2_K_RESTART[seed][step], (seed, step)
+
+
+def test_v3_step_words_pinned():
+    layout = _v3_layout()
+    assert layout.total_words == 10
+    assert layout.restart_off == 8
+    for seed, expect in V3_WORDS.items():
+        key = _lane_key(seed)
+        for step in range(2):
+            new_key, words, k_restart = step_words_v3(key, jnp.int32(step), layout)
+            assert words.tolist() == expect[step], (seed, step)
+            # immutable lane key + restart key = trailing block words
+            assert new_key.tolist() == key.tolist()
+            assert k_restart.tolist() == words[8:10].tolist()
+
+
+@pytest.mark.parametrize(
+    "faults,sched", [(V1_FAULTS, V1_SCHED), (V2_FAULTS, V2_SCHED)],
+    ids=["v1-derivation", "v2-derivation"],
+)
+@pytest.mark.parametrize("rng_stream", [2, 3], ids=["rng-v2", "rng-v3"])
+def test_fault_schedules_pinned(faults, sched, rng_stream):
+    """The fault-plan derivation is pinned AND independent of the step
+    stream version: flipping rng_stream=3 provably cannot disturb a
+    recorded schedule (both versions must reproduce the PR-1 constants)."""
+    eng = Engine(
+        RaftMachine(num_nodes=5, log_capacity=8),
+        EngineConfig(
+            horizon_us=5_000_000, queue_capacity=32, faults=faults,
+            rng_stream=rng_stream,
+        ),
+    )
+    for seed, expect in sched.items():
+        s = eng.init_lane(seed)
+        rows = slice(5, 5 + 2 * faults.n_faults)
+        assert s.eq_time[rows].tolist() == expect["time"], seed
+        assert s.eq_seq[rows].tolist() == expect["seq"], seed
+        assert s.eq_node[rows].tolist() == expect["node"], seed
+        assert s.eq_payload[rows].tolist() == expect["pay"], seed
+        assert bool(s.eq_valid[rows].all())
+
+
+def test_engine_v2_block_matches_module():
+    """The engine's own layout for the bench config must agree with the
+    module-level layout the golden words pin (guards against the engine
+    silently re-sizing the legacy block)."""
+    eng = Engine(
+        RaftMachine(num_nodes=5, log_capacity=8),
+        EngineConfig(horizon_us=5_000_000, queue_capacity=32, faults=V1_FAULTS),
+    )
+    assert eng._rng_layout == _v2_layout()
+    eng3 = Engine(
+        RaftMachine(num_nodes=5, log_capacity=8),
+        EngineConfig(
+            horizon_us=5_000_000, queue_capacity=32, faults=V1_FAULTS, rng_stream=3
+        ),
+    )
+    assert eng3._rng_layout == _v3_layout()

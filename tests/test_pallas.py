@@ -1,14 +1,17 @@
-"""Pallas event-pop kernel vs the XLA path — must agree bit-for-bit.
+"""Pallas event-pop kernels vs the XLA path — must agree bit-for-bit.
 
-Runs the kernel in interpreter mode (no TPU needed); the compiled-on-TPU
-path shares the same trace."""
+Runs the kernels in interpreter mode (no TPU needed); the
+compiled-on-TPU path shares the same trace. Covers both the pop-only
+kernel and the fused pop+gather kernel (the default TPU path since
+rng/pop/clog PR) over the queue capacities {32, 64} and payload widths
+{4, 6} the shipped models use."""
 
 import jax
 import jax.numpy as jnp
 import pytest
 
 from madsim_tpu.ops import pop_earliest
-from madsim_tpu.ops.pallas_pop import HAVE_PALLAS, pop_earliest_batch
+from madsim_tpu.ops.pallas_pop import HAVE_PALLAS, pop_earliest_batch, pop_gather_batch
 
 pytestmark = pytest.mark.skipif(not HAVE_PALLAS, reason="pallas unavailable")
 
@@ -19,6 +22,20 @@ def _random_queues(key, lanes=32, q=96):
     seqs = jax.random.randint(k2, (lanes, q), 0, 10_000, dtype=jnp.int32)
     valid = jax.random.bernoulli(k3, 0.7, (lanes, q))
     return times, seqs, valid
+
+
+def _random_event_queues(key, lanes, q, p):
+    times, seqs, valid = _random_queues(key, lanes, q)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    kinds = jax.random.randint(k1, (lanes, q), 0, 3, dtype=jnp.int32)
+    nodes = jax.random.randint(k2, (lanes, q), 0, 33, dtype=jnp.int32)
+    # src includes -1 (timer events) — the one-hot gather-sum must be
+    # exact for negatives too
+    srcs = jax.random.randint(k3, (lanes, q), -1, 33, dtype=jnp.int32)
+    payload = jax.random.randint(
+        k4, (lanes, q, p), -(2**20), 2**20, dtype=jnp.int32
+    )
+    return times, seqs, valid, kinds, nodes, srcs, payload
 
 
 def test_pallas_pop_matches_xla():
@@ -42,6 +59,55 @@ def test_pallas_pop_ties_and_empty():
     assert not bool(any_valid[3])
     for lane in (0, 1, 2, 4):
         assert int(idx[lane]) == 15  # smallest seq sits at the last column
+
+
+@pytest.mark.parametrize("q", [32, 64])
+@pytest.mark.parametrize("p", [4, 6])
+def test_fused_pop_gather_matches_xla(q, p):
+    """Fused pop+gather vs the XLA reference: the full popped event
+    tuple (idx, any, time, kind, node, src, payload) bit-for-bit, for
+    the queue capacities and payload widths the models use."""
+    for seed in range(3):
+        arrs = _random_event_queues(jax.random.PRNGKey(seed), 24, q, p)
+        xi, xa, (xt, xk, xn, xs, xp) = pop_gather_batch(*arrs, use_pallas=False)
+        pi, pa, (pt, pk, pn, ps, pp) = pop_gather_batch(
+            *arrs, use_pallas=True, interpret=True
+        )
+        assert xa.tolist() == pa.tolist()
+        for lane in range(24):
+            if not bool(xa[lane]):
+                continue
+            assert int(xi[lane]) == int(pi[lane]), (seed, lane)
+            assert int(xt[lane]) == int(pt[lane])
+            assert int(xk[lane]) == int(pk[lane])
+            assert int(xn[lane]) == int(pn[lane])
+            assert int(xs[lane]) == int(ps[lane])
+            assert xp[lane].tolist() == pp[lane].tolist()
+
+
+def test_fused_pop_gather_empty_lane_gathers_slot0():
+    """All-invalid lanes report any=False and gather slot 0 on BOTH
+    paths (XLA argmin over an all-sentinel row returns 0) — the step
+    masks the values out, but they must still agree bit-for-bit."""
+    arrs = list(_random_event_queues(jax.random.PRNGKey(5), 16, 32, 4))
+    arrs[2] = arrs[2].at[3].set(False).at[9].set(False)
+    xi, xa, xvals = pop_gather_batch(*arrs, use_pallas=False)
+    pi, pa, pvals = pop_gather_batch(*arrs, use_pallas=True, interpret=True)
+    assert not bool(xa[3]) and not bool(pa[3])
+    for lane in (3, 9):
+        assert int(xi[lane]) == int(pi[lane]) == 0
+        for xv, pv in zip(xvals, pvals):
+            assert xv[lane].tolist() == pv[lane].tolist()
+
+
+def test_fused_pop_gather_unaligned_lane_count():
+    arrs = _random_event_queues(jax.random.PRNGKey(11), 13, 32, 6)
+    xi, xa, xvals = pop_gather_batch(*arrs, use_pallas=False)
+    pi, pa, pvals = pop_gather_batch(*arrs, use_pallas=True, interpret=True)
+    assert pi.shape == (13,)
+    assert xa.tolist() == pa.tolist()
+    for xv, pv in zip(xvals, pvals):
+        assert xv.tolist() == pv.tolist()
 
 
 def test_pallas_pop_unaligned_lane_count():

@@ -1,0 +1,171 @@
+"""The three step-path gates (rng_stream / clog_packed / pallas pop) are
+result-preserving under their gates — each toggled OFF individually must
+leave run results bit-identical (clog_packed, pallas_pop: identical to
+the gate-ON run; rng_stream: v2 identical to the seed-era stream, pinned
+separately in test_golden_streams.py, and v3 self-consistent across
+executors and the replay path).
+
+Also covers the persistent-compilation-cache wiring (satellite)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
+from madsim_tpu.engine.replay import replay
+from madsim_tpu.models.raft import RaftMachine
+from madsim_tpu.ops.pallas_pop import HAVE_PALLAS
+
+# all six fault kinds + real packet loss: every clog representation and
+# every chaos-draw section of the RNG block is exercised
+FULL_CHAOS = EngineConfig(
+    horizon_us=2_000_000,
+    queue_capacity=64,
+    packet_loss_rate=0.01,
+    faults=FaultPlan(
+        n_faults=3, t_max_us=1_500_000, dur_min_us=100_000, dur_max_us=600_000,
+        allow_dir_clog=True, allow_group=True, allow_storm=True, allow_delay=True,
+    ),
+)
+BENCH_LIKE = EngineConfig(
+    horizon_us=2_000_000,
+    queue_capacity=32,
+    faults=FaultPlan(n_faults=2, t_max_us=1_500_000, dur_min_us=100_000, dur_max_us=600_000),
+)
+
+
+def _machine():
+    return RaftMachine(num_nodes=5, log_capacity=8)
+
+
+def _run(engine, n=48, max_steps=1200):
+    seeds = jnp.arange(n, dtype=jnp.uint32)
+    return jax.jit(lambda s: engine.run_batch(s, max_steps))(seeds)
+
+
+def _assert_results_equal(ra, rb):
+    for name in ("done", "failed", "fail_code", "now_us", "steps", "msg_count"):
+        a, b = getattr(ra, name), getattr(rb, name)
+        assert bool((a == b).all()), f"{name} diverged"
+    assert jax.tree.all(
+        jax.tree.map(lambda a, b: bool((a == b).all()), ra.summary, rb.summary)
+    )
+
+
+@pytest.mark.parametrize("cfg", [FULL_CHAOS, BENCH_LIKE], ids=["full-chaos", "bench-like"])
+@pytest.mark.parametrize("rng_stream", [2, 3], ids=["rng-v2", "rng-v3"])
+def test_clog_packed_gate_bit_identical(cfg, rng_stream):
+    cfg = dataclasses.replace(cfg, rng_stream=rng_stream)
+    r_packed = _run(Engine(_machine(), cfg))
+    r_bool = _run(Engine(_machine(), dataclasses.replace(cfg, clog_packed=False)))
+    _assert_results_equal(r_packed, r_bool)
+
+
+@pytest.mark.skipif(not HAVE_PALLAS, reason="pallas unavailable")
+def test_pallas_pop_gate_bit_identical():
+    # fused pop+gather (interpreter mode off-TPU) vs the XLA oracle
+    cfg = dataclasses.replace(FULL_CHAOS, rng_stream=3)
+    r_fused = _run(Engine(_machine(), cfg, use_pallas_pop=True), n=16, max_steps=300)
+    r_xla = _run(Engine(_machine(), cfg, use_pallas_pop=False), n=16, max_steps=300)
+    _assert_results_equal(r_fused, r_xla)
+
+
+def test_rng_v3_stream_executor_and_replay_agree():
+    """v3 results are executor-independent (batch vs stream) and the
+    host replay reproduces a v3 device finding bit-identically — the
+    same cross-engine contract v2 has."""
+    cfg = dataclasses.replace(FULL_CHAOS, rng_stream=3)
+    eng = Engine(_machine(), cfg)
+    out = eng.run_stream(96, batch=32, segment_steps=128, seed_start=0, max_steps=2500)
+    assert out["completed"] >= 96
+    res = _run(eng, n=96, max_steps=2500)
+    stream_codes = dict(out["failing"] + out["infra"])
+    batch_codes = {
+        int(s): int(c)
+        for s, c in zip(res.seeds.tolist(), res.fail_code.tolist())
+        if bool(res.failed[int(s)])
+    }
+    assert stream_codes == batch_codes
+    for seed, code in list(stream_codes.items())[:2]:
+        rp = replay(eng, seed, max_steps=2500, trace=False)
+        assert rp.failed and rp.fail_code == code
+
+
+def test_rng_v3_changes_the_stream():
+    """Sanity: v3 is a genuinely different stream (the gate is a
+    VERSION, not a no-op) — the two versions must not accidentally
+    alias, or the speedup would be fictional."""
+    eng2 = Engine(_machine(), BENCH_LIKE)
+    eng3 = Engine(_machine(), dataclasses.replace(BENCH_LIKE, rng_stream=3))
+    r2, r3 = _run(eng2, n=64), _run(eng3, n=64)
+    assert not bool((r2.now_us == r3.now_us).all())
+
+
+def test_v3_word_budget_shrinks_with_config():
+    """v3 sizes the block to what the config's fault-kind FLAGS can
+    consume; v2 never changes shape (that IS the legacy contract). The
+    layout is deliberately n_faults-independent — shrink bisects
+    n_faults, and the stream + compiled replay must survive that."""
+    m = _machine()  # MAX_MSGS = 4
+    no_chaos = EngineConfig(
+        queue_capacity=32, faults=FaultPlan(n_faults=0, allow_kill=False)
+    )
+    assert Engine(m, dataclasses.replace(no_chaos, rng_stream=3))._rng_layout.total_words == 8
+    assert Engine(m, no_chaos)._rng_layout.total_words == 12
+    full = dataclasses.replace(FULL_CHAOS, rng_stream=3)
+    # handler 4 + lat 4 + drop 4 + spike 8 + restart 2
+    assert Engine(m, full)._rng_layout.total_words == 22
+    # n_faults-independence: same layout (and jit-cache key) for every
+    # shrink candidate
+    import dataclasses as dc
+
+    shrunk = dc.replace(full, faults=dc.replace(full.faults, n_faults=0))
+    assert Engine(m, shrunk)._rng_layout == Engine(m, full)._rng_layout
+
+
+def test_corpus_roundtrip_records_gates():
+    from madsim_tpu.engine import corpus
+
+    cfg = dataclasses.replace(BENCH_LIKE, rng_stream=3, clog_packed=False)
+    d = corpus.config_to_dict(cfg)
+    assert d["rng_stream"] == 3 and d["clog_packed"] is False
+    assert "compile_cache_dir" not in d  # host-side knob, never recorded
+    back = corpus.config_from_dict(d)
+    assert back.rng_stream == 3 and back.clog_packed is False
+    # entries predating the gates decode to the legacy stream
+    legacy = {k: v for k, v in d.items() if k not in ("rng_stream", "clog_packed")}
+    assert corpus.config_from_dict(legacy).rng_stream == 2
+
+
+def test_clog_packed_rejects_oversized_machines():
+    class Wide(RaftMachine):
+        pass
+
+    m = Wide(num_nodes=5, log_capacity=8)
+    m.NUM_NODES = 61
+    with pytest.raises(ValueError, match="clog_packed"):
+        Engine(m, EngineConfig(queue_capacity=256, faults=FaultPlan(n_faults=0)))
+
+
+def test_compile_cache_wiring(tmp_path, monkeypatch):
+    """Engine(config.compile_cache_dir) enables the persistent cache and
+    compiles land in the directory. Process-global and first-dir-wins,
+    so the test tolerates a cache already enabled by another test."""
+    from madsim_tpu import compile_cache
+
+    target = str(tmp_path / "jit-cache")
+    monkeypatch.delenv("MADSIM_TPU_COMPILE_CACHE", raising=False)
+    eng = Engine(
+        _machine(),
+        dataclasses.replace(BENCH_LIKE, compile_cache_dir=target),
+    )
+    active = compile_cache.active_compile_cache()
+    assert active is not None
+    _run(eng, n=8, max_steps=64)
+    import os
+
+    assert os.path.isdir(active)
+    if active == os.path.abspath(target):  # first enabler in this process
+        assert os.listdir(active), "no cache entries written"
