@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test stest rtest check bench rpc-bench explore examples
+.PHONY: test stest rtest check bench rpc-bench explore examples audit
 
 # full suite (host engine + TPU engine on a hermetic 8-dev CPU mesh)
 test:
@@ -21,6 +21,10 @@ rtest:
 	$(PY) -m pytest tests/test_real_mode.py tests/test_grpc_real.py \
 		tests/test_etcd_real.py tests/test_s3_real.py \
 		tests/test_kafka_real.py -x -q
+
+# corpus digest-trail audit (first-divergent-checkpoint bisection)
+audit:
+	$(PY) -m madsim_tpu audit
 
 # determinism self-checks (host harness + engine)
 check:

@@ -145,6 +145,11 @@ def main() -> None:
     # gates land in the output JSON so BENCH_r* files are self-describing.
     rng_stream = int(os.environ.get("MADSIM_TPU_RNG_STREAM", "3"))
     clog_packed = os.environ.get("MADSIM_TPU_CLOG_PACKED", "1") not in ("", "0")
+    # Flight recorder (PR-3 observability gate): default ON so the
+    # flagship number is captured WITH digests + metrics riding the
+    # step (the acceptance bar: < 5% vs the recorder-off r6 capture);
+    # =0 for an A/B.
+    flight_recorder = os.environ.get("MADSIM_TPU_FLIGHT_RECORDER", "1") not in ("", "0")
     cfg = EngineConfig(
         horizon_us=5_000_000,
         # 32 slots: the real-chip queue sweep (PROFILE_r2.md) — the [L, Q]
@@ -155,6 +160,7 @@ def main() -> None:
         faults=FaultPlan(n_faults=2, t_max_us=3_000_000, dur_min_us=200_000, dur_max_us=800_000),
         rng_stream=rng_stream,
         clog_packed=clog_packed,
+        flight_recorder=flight_recorder,
     )
     eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
 
@@ -224,6 +230,10 @@ def main() -> None:
             step_cost["pallas_pop_off"] = one_rate(
                 Engine(eng.machine, cfg, use_pallas_pop=False)
             )
+        if cfg.flight_recorder:
+            step_cost["flight_recorder_off"] = one_rate(
+                Engine(eng.machine, dataclasses.replace(cfg, flight_recorder=False))
+            )
 
     print(
         json.dumps(
@@ -245,6 +255,7 @@ def main() -> None:
                     "rng_stream": cfg.rng_stream,
                     "clog_packed": cfg.clog_packed,
                     "pallas_pop": eng.use_pallas_pop,
+                    "flight_recorder": cfg.flight_recorder,
                     "compile_cache": active_compile_cache(),
                 },
                 "diagnostics": {
@@ -264,6 +275,12 @@ def main() -> None:
                     "segments_per_dispatch": stream_stats["segments_per_dispatch"],
                     "donation": stream_stats["donation"],
                     "pipelined": stream_stats["pipelined"],
+                    # on-device fault-injection / occupancy telemetry
+                    # harvested by the flight recorder (last rep)
+                    **(
+                        {"flight_recorder": stream_stats["flight_recorder"]}
+                        if "flight_recorder" in stream_stats else {}
+                    ),
                     **({"step_cost": step_cost} if step_cost else {}),
                 },
             }

@@ -127,6 +127,7 @@ def _build_engine(args):
         queue_capacity=args.queue,
         packet_loss_rate=args.loss,
         rng_stream=getattr(args, "rng_stream", 2),
+        flight_recorder=bool(getattr(args, "flight_recorder", False)),
         compile_cache_dir=getattr(args, "compile_cache", None),
         faults=FaultPlan(
             n_faults=args.faults,
@@ -187,6 +188,19 @@ def _stream_kwargs(args) -> dict:
     }
 
 
+def _print_fr_stats(stats) -> None:
+    """One metrics line when the flight recorder rode the stream."""
+    fr = stats.get("flight_recorder")
+    if not fr:
+        return
+    inj = ", ".join(f"{k}={v}" for k, v in fr["faults_injected"].items() if v)
+    print(
+        f"flight recorder: faults injected [{inj or 'none'}], "
+        f"queue hwm {fr['queue_hwm']}, clogged-links hwm {fr['clog_links_hwm']}, "
+        f"killed hwm {fr['killed_hwm']}"
+    )
+
+
 def _split_infra(failing):
     """Partition (seed, code) pairs into (findings, infra): OVERFLOW is
     a fixed-shape capacity abort — an infrastructure artifact that says
@@ -202,14 +216,14 @@ def _split_infra(failing):
 def _find_failing(eng, args):
     """Run the seed batch (streaming or fixed) and return
     (failing [(seed, code), ...], infra [(seed, code), ...],
-    abandoned_count)."""
+    abandoned_count, stream_stats)."""
     if args.stream:
         out = eng.run_stream(
             args.seeds, batch=min(args.seeds, args.batch), segment_steps=384,
             seed_start=args.seed, max_steps=args.max_steps,
             **_stream_kwargs(args),
         )
-        return out["failing"], out["infra"], len(out["abandoned"])
+        return out["failing"], out["infra"], len(out["abandoned"]), out["stats"]
     import jax.numpy as jnp
 
     seeds = jnp.arange(args.seed, args.seed + args.seeds, dtype=jnp.uint32)
@@ -220,7 +234,7 @@ def _find_failing(eng, args):
             eng.failing_seeds(res).tolist(), res.fail_code[res.failed].tolist()
         )
     )
-    return failing, infra, 0
+    return failing, infra, 0, {}
 
 
 def cmd_explore(args) -> int:
@@ -280,6 +294,7 @@ def cmd_explore(args) -> int:
             f"(pipelined={st['pipelined']}, donation={st['donation']}, "
             f"depth={st['dispatch_depth']}x{st['segments_per_dispatch']})"
         )
+        _print_fr_stats(st)
         if failing:
             codes = sorted({c for _s, c in failing})
             print(f"failure codes: {codes}")
@@ -307,10 +322,10 @@ def cmd_explore(args) -> int:
 def cmd_hunt(args) -> int:
     """explore -> shrink -> corpus: every found failing seed becomes a
     durable "open" regression entry with its minimized config."""
-    from .engine import corpus, shrink
+    from .engine import audit, corpus, shrink
 
     eng = _build_engine(args)
-    failing, infra, abandoned = _find_failing(eng, args)
+    failing, infra, abandoned, stream_stats = _find_failing(eng, args)
     print(
         f"hunted {args.seeds} seeds: {len(failing)} failing"
         + (f", {abandoned} abandoned (over --max-steps)" if abandoned else "")
@@ -320,6 +335,7 @@ def cmd_hunt(args) -> int:
             if infra else ""
         )
     )
+    _print_fr_stats(stream_stats)
     entries = corpus.load(args.corpus)
     known = {e.key for e in entries}
     added = 0
@@ -362,6 +378,9 @@ def cmd_hunt(args) -> int:
         if entry.key in known:
             print(f"  = corpus: seed {seed} code {code} already recorded")
             continue
+        # every new entry carries its digest trail + environment
+        # fingerprint from birth, so future rot is auditable
+        entry, _trail = audit.record_entry(entry, build_machine)
         known.add(entry.key)
         entries.append(entry)
         added += 1
@@ -436,6 +455,91 @@ def cmd_replay(args) -> int:
     print(f"seed {args.seed}: {status}, {len(rp.trace)} events, "
           f"t={int(rp.state.now_us)}us")
     return 1 if rp.failed else 0
+
+
+def cmd_trace(args) -> int:
+    """Replay one seed and export its virtual-time event timeline:
+    Chrome/Perfetto trace_event JSON (--perfetto, opens in
+    ui.perfetto.dev / chrome://tracing with one row per node) and/or
+    structured JSONL (--jsonl, one object per event)."""
+    from .engine import replay
+    from .engine.trace_export import write_jsonl, write_perfetto
+
+    if not args.perfetto and not args.jsonl:
+        sys.exit("trace needs at least one of --perfetto PATH / --jsonl PATH")
+    eng = _build_engine(args)
+    rp = replay(eng, args.seed, max_steps=args.max_steps)
+    n_nodes = eng.machine.NUM_NODES
+    if args.perfetto:
+        n = write_perfetto(
+            args.perfetto, rp.trace,
+            machine=args.machine, seed=args.seed, num_nodes=n_nodes,
+        )
+        print(f"wrote {n} events to {args.perfetto} (perfetto trace_event; "
+              f"open in https://ui.perfetto.dev)")
+    if args.jsonl:
+        n = write_jsonl(args.jsonl, rp.trace, machine=args.machine, seed=args.seed)
+        print(f"wrote {n} events to {args.jsonl} (JSONL)")
+    status = f"FAILED (code {rp.fail_code})" if rp.failed else "ok"
+    print(f"seed {args.seed}: {status}, {len(rp.trace)} events, "
+          f"t={int(rp.state.now_us)}us")
+    return 1 if rp.failed else 0
+
+
+def cmd_audit(args) -> int:
+    """Replay every corpus entry and bisect its recorded digest trail to
+    the first divergent checkpoint (the corpus-rot diagnosis). With
+    --record, re-record trails + environment metadata at HEAD instead —
+    refusing entries whose behavioral outcome no longer matches their
+    status contract (recording those would bake the rot in)."""
+    from .engine import audit, corpus
+
+    entries = corpus.load(args.corpus)
+    if not entries:
+        print(f"corpus {args.corpus} is empty")
+        return 0
+    bad = 0
+    changed = False
+    for i, e in enumerate(entries):
+        try:
+            if args.record:
+                new, trail = audit.record_entry(
+                    e, build_machine, every=args.digest_every
+                )
+                if e.status == corpus.STATUS_OPEN:
+                    contract_ok = trail.failed and trail.fail_code == e.fail_code
+                else:  # STATUS_FIXED must pass
+                    contract_ok = not trail.failed
+                if not contract_ok:
+                    got = (
+                        f"fails with code {trail.fail_code}"
+                        if trail.failed else "passes"
+                    )
+                    print(f"[FAIL] {e.machine} seed {e.seed}: replay {got}, "
+                          f"which breaks its {e.status!r} contract — NOT "
+                          f"recording (fix or re-hunt the entry first)")
+                    bad += 1
+                    continue
+                entries[i] = new
+                changed = True
+                print(f"[rec ] {e.machine} seed {e.seed} code {e.fail_code}: "
+                      f"{len(new.digests)} checkpoints every {new.digest_every} "
+                      f"steps, final step {new.digest_final[0]}")
+                continue
+            out = audit.audit_entry(e, build_machine)
+        except SystemExit:
+            print(f"[FAIL] {e.machine} seed {e.seed}: unknown machine in registry")
+            bad += 1
+            continue
+        tag = {"match": "ok  ", "no-digests": "??  ", "diverged": "DIVG"}[out.status]
+        print(f"[{tag}] {e.machine} seed {e.seed} code {e.fail_code}: {out.verdict}")
+        if not out.ok:
+            bad += 1
+    if changed:
+        corpus.save(args.corpus, entries)
+        print(f"corpus updated: {args.corpus}")
+    print(f"{len(entries) - bad}/{len(entries)} entries satisfied")
+    return 1 if bad else 0
 
 
 def cmd_shrink(args) -> int:
@@ -636,7 +740,20 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="madsim_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
+    def obs_flags(p):
+        """Observability flags (every subcommand): logging + recorder."""
+        p.add_argument(
+            "--log-level", default=os.environ.get("MADSIM_TPU_LOG"),
+            help="wire init_tracing at this level (DEBUG/INFO/...; also "
+            "$MADSIM_TPU_LOG) — log lines carry the sim span context",
+        )
+        p.add_argument(
+            "--log-jsonl", default=None, metavar="PATH",
+            help="also sink logs as structured JSONL to PATH",
+        )
+
     def common(p):
+        obs_flags(p)
         p.add_argument("--machine", default="raft")
         p.add_argument("--nodes", type=int, default=0)
         p.add_argument("--seed", type=int, default=0)
@@ -667,6 +784,12 @@ def main(argv=None) -> int:
             help="JAX persistent compilation cache directory (also "
             "$MADSIM_TPU_COMPILE_CACHE): pay each compile once per "
             "machine, not once per process",
+        )
+        p.add_argument(
+            "--flight-recorder", action="store_true",
+            help="engine flight recorder: rolling per-lane trace digests "
+            "+ checkpoint ring + on-device fault/queue metrics (results "
+            "are bit-identical either way; see `audit`)",
         )
 
     def stream_flags(p):
@@ -719,6 +842,23 @@ def main(argv=None) -> int:
                    help="events of context around the divergence")
     p.set_defaults(fn=cmd_replay)
 
+    p = sub.add_parser(
+        "trace",
+        help="replay one seed and export its virtual-time event timeline "
+        "(Perfetto trace_event JSON / structured JSONL)",
+    )
+    common(p)
+    p.add_argument(
+        "--perfetto", default=None, metavar="PATH",
+        help="write Chrome/Perfetto trace_event JSON (one thread row per "
+        "node, instants at virtual microseconds; open in ui.perfetto.dev)",
+    )
+    p.add_argument(
+        "--jsonl", default=None, metavar="PATH",
+        help="write one JSON object per event (grep/jq-able)",
+    )
+    p.set_defaults(fn=cmd_trace)
+
     p = sub.add_parser("shrink", help="minimize a failing seed's config")
     common(p)
     p.set_defaults(fn=cmd_shrink)
@@ -745,12 +885,32 @@ def main(argv=None) -> int:
         "regress",
         help="re-verify every corpus entry (open must reproduce, fixed must pass)",
     )
+    obs_flags(p)
     p.add_argument("--corpus", default="corpus.json")
     p.add_argument(
         "--promote", action="store_true",
         help="flip open entries that no longer fail to fixed",
     )
     p.set_defaults(fn=cmd_regress)
+
+    p = sub.add_parser(
+        "audit",
+        help="bisect every corpus entry's recorded digest trail to the "
+        "first divergent checkpoint (corpus-rot diagnosis); --record "
+        "re-records trails + env metadata at HEAD",
+    )
+    obs_flags(p)
+    p.add_argument("--corpus", default="corpus.json")
+    p.add_argument(
+        "--record", action="store_true",
+        help="re-record digest trails (refuses entries whose outcome "
+        "broke their status contract)",
+    )
+    p.add_argument(
+        "--digest-every", type=int, default=64,
+        help="checkpoint cadence in steps when recording",
+    )
+    p.set_defaults(fn=cmd_audit)
 
     p = sub.add_parser("check", help="engine determinism self-check")
     common(p)
@@ -807,6 +967,13 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)
+    if getattr(args, "log_level", None) or getattr(args, "log_jsonl", None):
+        from .tracing import init_tracing
+
+        init_tracing(
+            getattr(args, "log_level", None) or "INFO",
+            jsonl_path=getattr(args, "log_jsonl", None),
+        )
     if getattr(args, "multihost", False):
         # distributed init must precede ANY backend access — including
         # the watchdog's own device probe, which would pin a

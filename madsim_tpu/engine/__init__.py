@@ -15,6 +15,10 @@ See `core.py` for the architecture. Public surface:
   * `shrink(engine, seed)` — minimize a failing seed's config (shrink.py)
   * `EngineConfig(trace_ring=R)` + `Engine.ring_trace(result, lane)` —
     on-device last-R-events ring for post-mortems without replay
+  * `EngineConfig(flight_recorder=True)` — rolling per-lane trace
+    digests + checkpoint ring + on-device fault/queue metrics;
+    `audit.collect_trail` / `audit.first_divergence` bisect two trails
+    to the first divergent checkpoint (audit.py)
 """
 
 from .core import (
@@ -27,8 +31,10 @@ from .core import (
     EV_FAULT,
     EV_MSG,
     EV_TIMER,
+    FAULT_KIND_NAMES,
     OVERFLOW,
 )
+from . import audit
 from .machine import (
     BOOT,
     Machine,
@@ -71,5 +77,7 @@ __all__ = [
     "EV_TIMER",
     "EV_MSG",
     "EV_FAULT",
+    "FAULT_KIND_NAMES",
     "OVERFLOW",
+    "audit",
 ]

@@ -88,6 +88,41 @@ DELAY_EXTRA_SPAN_US = 4_000_001
 OK = 0
 OVERFLOW = 1  # event queue full — lane aborts (host fallback)
 
+# -- flight recorder (observability) ----------------------------------------
+# Rolling per-lane trace digest: a uint32[2] xor-rotate-multiply fold
+# over every popped event tuple plus the step's RNG word block. Not
+# cryptographic — built so any single-bit difference in any folded word
+# avalanches into both halves within one step, which is all divergence
+# detection needs. The IVs are pi's fractional bits (nothing-up-my-
+# sleeve); the multipliers are the Weyl/golden-ratio constant and
+# murmur3's fmix constant (both odd, so the map is a bijection on u32).
+DIGEST_IV0 = 0x243F6A88
+DIGEST_IV1 = 0x85A308D3
+_DIGEST_M0 = 0x9E3779B1
+_DIGEST_M1 = 0x85EBCA6B
+
+# FaultPlan kind names, indexed by K_* — the fault-injection counter
+# labels used by run_stream stats / bench / audit output.
+FAULT_KIND_NAMES = ("pair", "kill", "dir", "group", "storm", "delay")
+
+# StreamCarry.fr_metrics layout: 6 per-kind injection totals (summed at
+# harvest), then queue / clogged-link / killed-node high-water marks
+# (maxed at harvest).
+FR_METRICS_LEN = len(FAULT_KIND_NAMES) + 3
+
+
+def digest_fold(d0, d1, words):
+    """One digest round per word: d0 takes an xor-multiply-xorshift, d1
+    takes a rotated xor-multiply and absorbs d0 so the halves couple.
+    `words` is a python list of traced scalars (static unroll)."""
+    for w in words:
+        w = jnp.asarray(w).astype(jnp.uint32)
+        d0 = (d0 ^ w) * jnp.uint32(_DIGEST_M0)
+        d0 = d0 ^ (d0 >> 16)
+        d1 = (d1 ^ ((w << 13) | (w >> 19))) * jnp.uint32(_DIGEST_M1)
+        d1 = d1 ^ (d1 >> 15) ^ d0
+    return d0, d1
+
 # Bit-packed clog rows: node j of row i lives in word j // 30, bit
 # j % 30 — the SAME 30-bits-per-int32 encoding the group-partition
 # payload masks use (payload args 1+2), so the two-word row covers the
@@ -211,6 +246,19 @@ class EngineConfig:
     # representation swap: results are bit-identical either way (tests
     # assert); False keeps the bool-matrix oracle. Requires N <= 60.
     clog_packed: bool = True
+    # Flight recorder (observability): a rolling per-lane trace digest —
+    # a uint32[2] fold over each popped (time, kind, node, src, payload)
+    # tuple plus the step-RNG word block — checkpointed into a small
+    # on-device ring every `fr_digest_every` steps, plus on-device
+    # fault-injection / queue / clog occupancy metrics. Rides the
+    # existing result harvest (zero extra host syncs); the gate-off path
+    # is bit-identical (tests assert). Two digest trails agree exactly
+    # as far as the two executions agree, so the first divergent
+    # checkpoint localizes a determinism break to one segment —
+    # `python -m madsim_tpu audit` (engine/audit.py) is the consumer.
+    flight_recorder: bool = False
+    fr_digest_every: int = 64  # steps between digest checkpoints
+    fr_digest_ring: int = 32  # checkpoints retained per lane (ring)
     # Opt-in JAX persistent compilation cache directory (also
     # $MADSIM_TPU_COMPILE_CACHE): hunts and sweeps pay each multi-second
     # compile once per machine instead of once per process. Host-side
@@ -243,6 +291,7 @@ class LaneState:
     killed: jax.Array  # bool[N]
     nodes: Any
     ring: Any  # {} when trace_ring == 0, else dict of [R]/[R,P] arrays
+    fr: Any  # {} unless flight_recorder: digest + checkpoint ring + metrics
 
 
 @struct.dataclass
@@ -264,6 +313,7 @@ class StreamCarry:
     ab_seeds: jax.Array  # uint32[C]
     ab_count: jax.Array  # int32 scalar
     counters: jax.Array  # uint32[6]: completed, fail_count, ab_count, next_seed, flags, segments
+    fr_metrics: jax.Array  # int32[FR_METRICS_LEN]: flight-recorder totals (zeros when off)
 
 
 @struct.dataclass
@@ -277,6 +327,7 @@ class BatchResult:
     msg_count: jax.Array
     summary: Any
     ring: Any  # per-lane event rings ({} unless config.trace_ring > 0)
+    fr: Any  # per-lane flight-recorder state ({} unless flight_recorder)
 
 
 class Engine:
@@ -343,6 +394,13 @@ class Engine:
             raise ValueError(
                 f"rng_stream={config.rng_stream!r} unknown; supported "
                 f"versions: {RNG_STREAM_VERSIONS}"
+            )
+        if config.flight_recorder and (
+            config.fr_digest_every < 1 or config.fr_digest_ring < 1
+        ):
+            raise ValueError(
+                "flight_recorder needs fr_digest_every >= 1 and "
+                "fr_digest_ring >= 1"
             )
         # Static step-RNG block layout + compute-elision flags: which
         # chaos draws this (config, machine) pair can ever consume.
@@ -495,7 +553,27 @@ class Engine:
             killed=jnp.zeros((n,), bool),
             nodes=nodes,
             ring=self._empty_ring(),
+            fr=self._empty_fr(),
         )
+
+    def _empty_fr(self):
+        """Fresh flight-recorder state: digest at its IV, empty
+        checkpoint ring (step -1 = unused slot), zeroed metrics."""
+        cfg = self.config
+        if not cfg.flight_recorder:
+            return {}
+        r = cfg.fr_digest_ring
+        return {
+            "d0": jnp.uint32(DIGEST_IV0),
+            "d1": jnp.uint32(DIGEST_IV1),
+            "ck_step": jnp.full((r,), -1, jnp.int32),
+            "ck_d0": jnp.zeros((r,), jnp.uint32),
+            "ck_d1": jnp.zeros((r,), jnp.uint32),
+            "inj": jnp.zeros((len(FAULT_KIND_NAMES),), jnp.int32),
+            "q_hwm": jnp.int32(0),
+            "clog_hwm": jnp.int32(0),
+            "kill_hwm": jnp.int32(0),
+        }
 
     def _empty_ring(self):
         r = self.config.trace_ring
@@ -809,6 +887,63 @@ class Engine:
         eq = _push(eq, slot, want_boot, new_now, next_seq, EV_TIMER, boot_node, jnp.int32(-1), boot_pay)
         next_seq = next_seq + jnp.where(want_boot, 1, 0)
 
+        # -- flight recorder (observability; gate-off adds NO ops) ----------
+        fr = s.fr
+        if cfg.flight_recorder:
+            stepped = jnp.bool_(True) if active is None else active
+            new_step = s.step + stepped.astype(jnp.int32)
+            # digest: fold the popped tuple + the step's whole RNG word
+            # block — exactly the inputs that determine this step — on
+            # every step that pops an event (same condition as the trace
+            # ring / replay trace)
+            nd0, nd1 = digest_fold(
+                fr["d0"],
+                fr["d1"],
+                [ev_time, ev_kind, ev_node, ev_src]
+                + [ev_payload[i] for i in range(m.PAYLOAD_WIDTH)]
+                + [step_words[i] for i in range(layout.total_words)],
+            )
+            d0 = jnp.where(live, nd0, fr["d0"])
+            d1 = jnp.where(live, nd1, fr["d1"])
+            # checkpoint ring: every `fr_digest_every`-th step the lane
+            # actually executes lands (step, d0, d1) in slot
+            # (step/every - 1) % ring — the host decodes by sorting on
+            # step. Condition is "the step counter crossed a multiple",
+            # not "popped": the audit's host-side trail reads the digest
+            # at exact step multiples and must see the same checkpoints.
+            every, rr = cfg.fr_digest_every, cfg.fr_digest_ring
+            want_ck = stepped & (new_step % every == 0)
+            ck_slot = ((new_step // every - 1) % rr == jnp.arange(rr)) & want_ck
+            # fault-injection counters: one per FaultPlan kind, counted
+            # when an APPLY op (even payload[0]) is processed
+            is_inj = process & (ev_kind == EV_FAULT) & (ev_payload[0] % 2 == 0)
+            kind_idx = ev_payload[0] // 2
+            inj = fr["inj"] + (
+                (jnp.arange(len(FAULT_KIND_NAMES)) == kind_idx) & is_inj
+            ).astype(jnp.int32)
+            # occupancy high-water marks on the post-step state (frozen
+            # lanes' state is unchanged, so their marks are stable)
+            n_clog = (
+                lax.population_count(clogged).sum()
+                if cfg.clog_packed
+                else clogged.sum()
+            ).astype(jnp.int32)
+            fr = {
+                "d0": d0,
+                "d1": d1,
+                "ck_step": jnp.where(ck_slot, new_step, fr["ck_step"]),
+                "ck_d0": jnp.where(ck_slot, d0, fr["ck_d0"]),
+                "ck_d1": jnp.where(ck_slot, d1, fr["ck_d1"]),
+                "inj": inj,
+                "q_hwm": jnp.maximum(
+                    fr["q_hwm"], eq["valid"].sum().astype(jnp.int32)
+                ),
+                "clog_hwm": jnp.maximum(fr["clog_hwm"], n_clog),
+                "kill_hwm": jnp.maximum(
+                    fr["kill_hwm"], killed.sum().astype(jnp.int32)
+                ),
+            }
+
         # -- invariants / termination ---------------------------------------
         ok, code = m.invariant(nodes, new_now)
         inv_fail = process & ~ok
@@ -847,6 +982,7 @@ class Engine:
             killed=killed,
             nodes=nodes,
             ring=ring,
+            fr=fr,
         )
 
     # -- batch runners -------------------------------------------------------
@@ -897,6 +1033,7 @@ class Engine:
             msg_count=final.msg_count,
             summary=jax.vmap(self.machine.summary)(final.nodes),
             ring=final.ring,
+            fr=final.fr,
         )
 
     def run_segment(self, state: LaneState, segment_steps: int) -> LaneState:
@@ -1000,6 +1137,7 @@ class Engine:
                 ab_seeds=jnp.zeros((cap,), jnp.uint32),
                 ab_count=jnp.int32(0),
                 counters=jnp.zeros((6,), jnp.uint32),
+                fr_metrics=jnp.zeros((FR_METRICS_LEN,), jnp.int32),
             )
             return c.replace(counters=_counters(c))
 
@@ -1043,6 +1181,29 @@ class Engine:
             ab_mask = done & ~state.failed & over_cap
             ab_seeds, ab_count = _append_ring(c.ab_seeds, c.ab_count, ab_mask, seeds)
 
+            # flight-recorder totals ride the harvest: injection counts
+            # of lanes finishing THIS segment sum in, high-water marks
+            # max in — one small device-resident vector, read by the
+            # host only at the final drain (zero extra steady-state
+            # syncs)
+            fr_metrics = c.fr_metrics
+            if self.config.flight_recorder:
+                frs = state.fr
+                nk = len(FAULT_KIND_NAMES)
+                inj_tot = fr_metrics[:nk] + (
+                    frs["inj"] * done[:, None].astype(jnp.int32)
+                ).sum(axis=0)
+                hwm = jnp.stack(
+                    [
+                        jnp.maximum(
+                            fr_metrics[nk + i],
+                            jnp.where(done, frs[k], 0).max(),
+                        )
+                        for i, k in enumerate(("q_hwm", "clog_hwm", "kill_hwm"))
+                    ]
+                )
+                fr_metrics = jnp.concatenate([inj_tot, hwm])
+
             new = StreamCarry(
                 state=state,
                 seeds=seeds,
@@ -1056,6 +1217,7 @@ class Engine:
                 ab_seeds=ab_seeds,
                 ab_count=ab_count,
                 counters=c.counters,
+                fr_metrics=fr_metrics,
             )
             return new.replace(counters=_counters(new))
 
@@ -1237,6 +1399,16 @@ class Engine:
 
         counters = poll(carry)
         carry = drain(carry)
+        fr_stats = {}
+        if self.config.flight_recorder:
+            # one extra small transfer, after streaming is over
+            from ..runtime.metrics import fr_metrics_dict
+
+            fr_stats = {
+                "flight_recorder": fr_metrics_dict(
+                    jax.device_get(carry.fr_metrics)
+                )
+            }
         return {
             "completed": int(counters[0]),
             "failing": failing,
@@ -1250,6 +1422,7 @@ class Engine:
                 "segments_per_dispatch": segments_per_dispatch if pipelined else 1,
                 "donation": bool(donate),
                 "pipelined": bool(pipelined),
+                **fr_stats,
             },
         }
 
@@ -1311,6 +1484,20 @@ class Engine:
         ring = result.ring
         lane_ring = jax.tree.map(lambda a: a[lane], ring)
         return decode_ring(lane_ring)
+
+    def digest_checkpoints(self, result, lane: int):
+        """Decode lane `lane`'s digest checkpoint ring into a list of
+        (step, d0, d1) tuples, oldest first (the last
+        `config.fr_digest_ring` checkpoints). Requires
+        `flight_recorder=True`."""
+        from .audit import decode_checkpoint_ring
+
+        if not self.config.flight_recorder:
+            raise ValueError(
+                "engine built with flight_recorder=False — no digests recorded"
+            )
+        lane_fr = jax.tree.map(lambda a: a[lane], result.fr)
+        return decode_checkpoint_ring(lane_fr)
 
     def check_determinism(self, seeds: jax.Array, max_steps: int = 10_000) -> BatchResult:
         """Run the batch twice and require exactly equal results — the

@@ -33,6 +33,12 @@ def config_to_dict(cfg: EngineConfig) -> dict:
     # host-side knob, never trace-affecting: a corpus entry must replay
     # on any machine, not name some other box's cache directory
     d.pop("compile_cache_dir", None)
+    # the flight recorder is asserted bit-identical under its gate, so
+    # entries don't record it: the digest trail lives in the entry's own
+    # digests/digest_final fields, and the auditor re-enables the
+    # recorder itself at the recorded cadence
+    for k in ("flight_recorder", "fr_digest_every", "fr_digest_ring"):
+        d.pop(k, None)
     return d
 
 
@@ -53,13 +59,24 @@ class CorpusEntry:
     max_steps: int
     nodes: int = 0
     note: str = ""
+    # Flight-recorder provenance (engine/audit.py): the digest trail
+    # recorded when the entry was (re-)recorded — checkpoints every
+    # `digest_every` steps as [step, d0, d1], the final [step, d0, d1],
+    # and the environment fingerprint (jax/jaxlib/python/engine
+    # versions) it was recorded under. `python -m madsim_tpu audit`
+    # replays the entry and bisects this trail to the first divergent
+    # checkpoint; entries predating the recorder carry empty trails.
+    digest_every: int = 0
+    digests: list = dataclasses.field(default_factory=list)
+    digest_final: list = dataclasses.field(default_factory=list)
+    meta: dict = dataclasses.field(default_factory=dict)
 
     @property
     def key(self) -> tuple:
         return (self.machine, self.nodes, self.seed, self.fail_code)
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "machine": self.machine,
             "nodes": self.nodes,
             "seed": self.seed,
@@ -69,6 +86,13 @@ class CorpusEntry:
             "note": self.note,
             "config": config_to_dict(self.config),
         }
+        if self.digest_every:
+            d["digest_every"] = self.digest_every
+            d["digests"] = [[int(x) for x in ck] for ck in self.digests]
+            d["digest_final"] = [int(x) for x in self.digest_final]
+        if self.meta:
+            d["meta"] = dict(self.meta)
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "CorpusEntry":
@@ -81,6 +105,10 @@ class CorpusEntry:
             max_steps=int(d["max_steps"]),
             note=d.get("note", ""),
             config=config_from_dict(d["config"]),
+            digest_every=int(d.get("digest_every", 0)),
+            digests=[[int(x) for x in ck] for ck in d.get("digests", [])],
+            digest_final=[int(x) for x in d.get("digest_final", [])],
+            meta=dict(d.get("meta", {})),
         )
 
 

@@ -138,6 +138,9 @@ def _trace_affecting_key(engine: Engine) -> tuple:
         cfg.handler_rand_words,
         cfg.trace_ring,
         cfg.clog_packed,
+        cfg.flight_recorder,
+        cfg.fr_digest_every,
+        cfg.fr_digest_ring,
         engine._rng_layout,  # stream version + word-block layout
         engine.use_pallas_pop,
     )

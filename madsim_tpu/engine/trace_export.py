@@ -1,0 +1,139 @@
+"""Host-side trace export: Perfetto/Chrome trace_event JSON and JSONL.
+
+A replayed seed's event trace is a virtual-time timeline: every popped
+event names the node that handled it and the virtual microsecond it ran
+at. The Chrome `trace_event` export maps that onto the profiler UI's
+native model — one process per simulated seed, one thread row per node,
+instant events at virtual timestamps — so `chrome://tracing` or
+https://ui.perfetto.dev renders a seed's schedule (elections, message
+storms, fault windows) exactly like a CPU profile, scrubber and all.
+
+The JSONL export is the machine-readable sibling: one JSON object per
+event, grep/jq-able, stable keys — the structured counterpart of
+`replay --tail`'s human lines (the logging-based JSONL sink for *live*
+host-runtime logs is `tracing.JsonlHandler`; this module serializes
+engine traces).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from .replay import TraceEvent
+
+
+def trace_event_dict(
+    events: List[TraceEvent],
+    *,
+    machine: str = "machine",
+    seed: int = 0,
+    num_nodes: Optional[int] = None,
+) -> dict:
+    """Build the Chrome trace_event JSON object (dict) for one replayed
+    seed. Timestamps are VIRTUAL microseconds (trace_event's native
+    unit, so the UI's time axis reads as simulation time directly)."""
+    pid = 0
+    out: List[dict] = [
+        {
+            "ph": "M",
+            "pid": pid,
+            "name": "process_name",
+            "args": {"name": f"{machine} seed {seed}"},
+        }
+    ]
+    nodes = sorted({ev.node for ev in events})
+    if num_nodes is not None:
+        nodes = sorted(set(nodes) | set(range(num_nodes)))
+    for n in nodes:
+        out.append(
+            {
+                "ph": "M",
+                "pid": pid,
+                "tid": n,
+                "name": "thread_name",
+                "args": {"name": f"node {n}"},
+            }
+        )
+        # sort_index keeps node rows in id order (tracing UIs otherwise
+        # order threads by first event)
+        out.append(
+            {
+                "ph": "M",
+                "pid": pid,
+                "tid": n,
+                "name": "thread_sort_index",
+                "args": {"sort_index": n},
+            }
+        )
+    for ev in events:
+        name = ev.kind
+        if ev.kind == "msg":
+            name = f"msg<-{ev.src}"
+        elif ev.kind == "fault":
+            name = f"fault op={ev.payload[0]}"
+        elif ev.kind == "timer":
+            name = f"timer id={ev.payload[0]}"
+        out.append(
+            {
+                "ph": "i",  # instant: handlers take zero virtual time
+                "s": "t",  # thread-scoped marker
+                "pid": pid,
+                "tid": ev.node,
+                "ts": ev.time_us,
+                "name": name,
+                "args": {
+                    "step": ev.step,
+                    "src": ev.src,
+                    "payload": list(ev.payload),
+                },
+            }
+        )
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def write_perfetto(
+    path: str,
+    events: List[TraceEvent],
+    *,
+    machine: str = "machine",
+    seed: int = 0,
+    num_nodes: Optional[int] = None,
+) -> int:
+    """Write the Perfetto/Chrome trace_event JSON file. Returns the
+    number of trace events written (excluding metadata records)."""
+    doc = trace_event_dict(events, machine=machine, seed=seed, num_nodes=num_nodes)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+        f.write("\n")
+    return len(events)
+
+
+def write_jsonl(
+    path: str,
+    events: List[TraceEvent],
+    *,
+    machine: str = "machine",
+    seed: int = 0,
+) -> int:
+    """Write one JSON object per trace event: {"machine", "seed",
+    "step", "t_us", "kind", "node", "src", "payload"}. Returns the
+    number of lines written."""
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(
+                json.dumps(
+                    {
+                        "machine": machine,
+                        "seed": seed,
+                        "step": ev.step,
+                        "t_us": ev.time_us,
+                        "kind": ev.kind,
+                        "node": ev.node,
+                        "src": ev.src,
+                        "payload": list(ev.payload),
+                    }
+                )
+            )
+            f.write("\n")
+    return len(events)

@@ -65,6 +65,21 @@ try:  # jax >= 0.4.16
 except Exception:  # pragma: no cover - older jax layouts
     from jax._src.prng import threefry_2x32  # type: ignore
 
+# The stream contract also pins the PRNG *lowering*. jax's
+# `jax_threefry_partitionable` flag changes the bits jax.random.split /
+# jax.random.bits produce for the SAME key, and jax has flipped its
+# default across releases — the PR-3 corpus-rot investigation traced
+# "all 8 corpus entries and slow-seed 66531 stopped reproducing" to
+# exactly this: they were recorded under partitionable=True (the
+# real-chip box's newer jax) and replayed under a False-default jax,
+# which silently re-derived every lane key, fault schedule and v2 step
+# block. Pinned True — the value the historical corpus was recorded
+# under and the one newer jax keeps — so the streams are a function of
+# the seed alone, not of the installed jax version. (The raw
+# threefry_2x32 kernel v3 uses is flag-independent; the lane-key
+# derivation above it is not.)
+jax.config.update("jax_threefry_partitionable", True)
+
 RNG_STREAM_LEGACY = 2
 RNG_STREAM_COUNTER = 3
 RNG_STREAM_VERSIONS = (RNG_STREAM_LEGACY, RNG_STREAM_COUNTER)

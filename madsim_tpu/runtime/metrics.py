@@ -1,11 +1,39 @@
-"""Runtime metrics (reference: madsim/src/sim/runtime/metrics.rs)."""
+"""Runtime metrics (reference: madsim/src/sim/runtime/metrics.rs).
+
+Also the host-side decoder for the TPU engine's flight-recorder metrics
+vector (`StreamCarry.fr_metrics` / `LaneState.fr`): the device
+accumulates per-fault-kind injection counters and occupancy high-water
+marks in the step kernel; `fr_metrics_dict` turns the harvested int
+vector into the labelled dict that run_stream stats, bench.py and the
+hunt report print.
+"""
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Dict
+from typing import TYPE_CHECKING, Dict, Sequence
 
 if TYPE_CHECKING:
     from ..task.executor import Executor
+
+# Mirrors engine/core.py's FAULT_KIND_NAMES / FR_METRICS_LEN (kept as
+# literals here so this host-side module never imports jax).
+FR_FAULT_KINDS = ("pair", "kill", "dir", "group", "storm", "delay")
+
+
+def fr_metrics_dict(vec: Sequence[int]) -> Dict[str, object]:
+    """Decode a flight-recorder metrics vector: 6 per-kind fault
+    injection totals, then queue / clogged-link / killed-node high-water
+    marks."""
+    v = [int(x) for x in vec]
+    nk = len(FR_FAULT_KINDS)
+    if len(v) != nk + 3:
+        raise ValueError(f"expected {nk + 3} metric words, got {len(v)}")
+    return {
+        "faults_injected": dict(zip(FR_FAULT_KINDS, v[:nk])),
+        "queue_hwm": v[nk],
+        "clog_links_hwm": v[nk + 1],
+        "killed_hwm": v[nk + 2],
+    }
 
 
 class RuntimeMetrics:

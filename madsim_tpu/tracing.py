@@ -12,8 +12,11 @@ span-like entry/exit logs.
 from __future__ import annotations
 
 import functools
+import inspect
+import json
 import logging
-from typing import Any, Callable
+import time
+from typing import Any, Callable, Optional
 
 from . import _context
 
@@ -36,32 +39,102 @@ class SimContextFilter(logging.Filter):
         return True
 
 
-def init_tracing(level: str = "INFO") -> None:
+class JsonlHandler(logging.Handler):
+    """Structured JSONL log sink: one JSON object per record —
+    {"ts", "level", "logger", "sim", "msg"} — append-mode, grep/jq-able.
+    The machine-readable counterpart of the human StreamHandler format
+    (engine traces have their own serializer, engine/trace_export.py)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self._f = open(path, "a")
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self._f.write(
+                json.dumps(
+                    {
+                        "ts": round(time.time(), 6),
+                        "level": record.levelname,
+                        "logger": record.name,
+                        "sim": getattr(record, "sim", "-"),
+                        "msg": record.getMessage(),
+                    }
+                )
+            )
+            self._f.write("\n")
+            self._f.flush()
+        except Exception:  # never let logging take down the sim
+            self.handleError(record)
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        finally:
+            super().close()
+
+
+def init_tracing(level: str = "INFO", jsonl_path: Optional[str] = None) -> None:
     """Install a handler whose format includes the sim span context
-    (reference: init_logger, sim/runtime/mod.rs:445-449)."""
+    (reference: init_logger, sim/runtime/mod.rs:445-449). With
+    `jsonl_path`, a structured JSONL sink (JsonlHandler) is installed
+    alongside the human-readable stream handler."""
     root = logging.getLogger()
     root.setLevel(getattr(logging, level.upper()))
     handler = logging.StreamHandler()
     handler.setFormatter(logging.Formatter("%(levelname)s [%(sim)s] %(name)s: %(message)s"))
     handler.addFilter(SimContextFilter())
     root.addHandler(handler)
+    if jsonl_path:
+        jh = JsonlHandler(jsonl_path)
+        jh.addFilter(SimContextFilter())
+        root.addHandler(jh)
 
 
 def instrument(fn: Callable[..., Any] = None, *, name: str = "", level: int = logging.DEBUG):
-    """Span-style decorator: logs entry/exit of an async fn with the sim
-    context (reference: `#[instrument]` on net ops)."""
+    """Span-style decorator: logs entry/exit of a sync or async fn with
+    the sim context (reference: `#[instrument]` on net ops). An
+    exception exits the span as `exit <span> raised <Type>: <msg>` (at
+    the same level — spans are tracing, the exception itself still
+    propagates to whoever handles it)."""
 
     def deco(f):
         span = name or f.__qualname__
         logger = logging.getLogger(f.__module__)
 
-        @functools.wraps(f)
-        async def wrapper(*args, **kwargs):
-            logger.log(level, "enter %s", span)
-            try:
-                return await f(*args, **kwargs)
-            finally:
-                logger.log(level, "exit %s", span)
+        def _exit_ok():
+            logger.log(level, "exit %s", span)
+
+        def _exit_exc(exc: BaseException):
+            logger.log(
+                level, "exit %s raised %s: %s", span, type(exc).__name__, exc
+            )
+
+        if inspect.iscoroutinefunction(f):
+
+            @functools.wraps(f)
+            async def wrapper(*args, **kwargs):
+                logger.log(level, "enter %s", span)
+                try:
+                    result = await f(*args, **kwargs)
+                except BaseException as exc:
+                    _exit_exc(exc)
+                    raise
+                _exit_ok()
+                return result
+
+        else:
+
+            @functools.wraps(f)
+            def wrapper(*args, **kwargs):
+                logger.log(level, "enter %s", span)
+                try:
+                    result = f(*args, **kwargs)
+                except BaseException as exc:
+                    _exit_exc(exc)
+                    raise
+                _exit_ok()
+                return result
 
         return wrapper
 

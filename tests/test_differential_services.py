@@ -55,7 +55,11 @@ def test_mvcc_machine_matches_service_under_chaos():
         dur_max_us=800_000,
     )
     eng = _mvcc_engine(faults=faults, horizon_us=8_000_000)
-    for seed in range(8):
+    # seeds 16..28: the range the bidirectional lease-bug test below
+    # needs (it must flag drift on seeds THIS test certifies as clean);
+    # re-picked when the PR-3 partitionable pin restored the seed-era
+    # streams and moved which seeds block keepalives long enough
+    for seed in range(16, 28):
         out = differential_etcd_mvcc(eng, seed)
         assert out["ok"], (seed, out["mismatches"])
 
@@ -111,7 +115,9 @@ def test_mvcc_differential_catches_service_side_lease_bug():
     eng = _mvcc_engine(faults=faults, horizon_us=8_000_000)
     buggy = lambda rng: EtcdService(rng, lease_expiry_off_by_one=True)
     flagged = []
-    for seed in range(8):
+    # same 16..28 range the clean chaos test certifies (seeds 18/19/21/
+    # 25 reach the expiry sweep under the pinned seed-era streams)
+    for seed in range(16, 28):
         out = differential_etcd_mvcc(eng, seed, service_factory=buggy)
         if not out["ok"]:
             flagged.append((seed, out["mismatches"]))

@@ -174,7 +174,14 @@ def test_raft_overcommit_bug_found_at_scale_and_fixed():
     committed (LOG_MATCHING: one node committed term-1 entries 6-8 where
     the cluster committed term-2 ones). The buggy bound is kept behind
     COMMIT_TO_LOG_LEN; the exact found seed must fail with it and pass
-    without it."""
+    without it.
+
+    History: this seed stopped reproducing for two rounds — the PR-3
+    corpus-rot audit traced it (and all 8 corpus entries) to jax's
+    jax_threefry_partitionable default differing between the recording
+    box and this container. The engine now pins the lowering
+    (ops/step_rng.py) and the seed reproduces again; NOTES_PR3.md has
+    the full bisection."""
 
     class OvercommitRaft(RaftMachine):
         COMMIT_TO_LOG_LEN = True

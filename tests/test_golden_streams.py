@@ -8,10 +8,18 @@ stream, this file fails before a single corpus entry gets a chance to
 drift — the rng_stream=3 gate (and anything after it) provably cannot
 touch the legacy streams.
 
-The v1/v2 constants were captured from the pre-v3 engine (PR-1 HEAD,
-e0405fb); the v3 constants pin the NEW stream so it too is frozen from
-birth. A deliberate stream change must ship as a new version, never as
-an edit to these numbers.
+History (PR-3, the corpus-rot incident): the constants here were
+originally captured at PR-1 HEAD (e0405fb) — in an environment where
+jax's `jax_threefry_partitionable` flag defaulted FALSE. The corpus and
+slow-seed 66531 were recorded earlier, on a box whose newer jax
+defaulted it TRUE, producing different split/bits streams for the same
+seed; the flag gap — not any engine edit — was the whole "corpus rot"
+(NOTES_PR3.md carries the bisection). The engine now pins
+partitionable=True in ops/step_rng.py (the recording-era value and the
+one modern jax keeps), and the constants below are the re-capture under
+that pinned lowering — i.e. the restored ORIGINAL seed-era streams.
+With the lowering pinned, a deliberate stream change must ship as a new
+version, never as an edit to these numbers.
 """
 
 import dataclasses
@@ -34,82 +42,85 @@ from madsim_tpu.ops.step_rng import (
 
 # v2 step words: handler_rand_words=4, MAX_MSGS=4, allow_delay off
 # => 12-word block; key chain PRNGKey(seed) -> split(3) -> per-step
-# split(3)+bits. Captured at PR-1 HEAD.
+# split(3)+bits. Re-captured under the pinned partitionable lowering
+# (PR-3) — the restored seed-era stream.
 V2_WORDS = {
     7: [
-        [4214792054, 1260227468, 1640883124, 2425832054, 3605214257, 3166382466,
-         3927872912, 2408175273, 2750083161, 428900463, 4137107995, 3015843103],
-        [3333476539, 4045693078, 1033620173, 3623907546, 1060330335, 1712605834,
-         3849462251, 3304002638, 3770916476, 933675449, 906760448, 2718080322],
+        [4241556475, 84765514, 193814917, 4022430017, 1899920453, 4270662650,
+         3438644710, 482149783, 3504413964, 2380566562, 1683184507, 3477902931],
+        [3620214620, 1532762980, 674263535, 631928992, 612896602, 2081840896,
+         2783207604, 1313509888, 732748563, 922991306, 564573486, 2599884155],
     ],
     123: [
-        [2496579800, 651695700, 3729129202, 375214000, 2025909036, 2774168915,
-         3670720520, 207514721, 4233063012, 4123477057, 402553556, 2553420927],
-        [1885868696, 2996385906, 1588223244, 3457262576, 796519027, 1918105540,
-         2147996441, 1958354035, 2654864958, 203416391, 2373135289, 2173715111],
+        [135492065, 1353318086, 2088731245, 1196048, 2557717920, 1222849717,
+         567684486, 2729488727, 654290142, 1887700272, 3147832536, 3759350190],
+        [994083955, 2970041183, 540460582, 1847628849, 842695244, 4247492917,
+         2100597832, 894227792, 1875384957, 1343808822, 2415306344, 1404810419],
     ],
 }
 V2_K_RESTART = {
-    7: [[2619868301, 2210700558], [2304019816, 3891442957]],
-    123: [[3458513999, 889850992], [64212938, 1747517915]],
+    7: [[2068379011, 934402480], [691513977, 469030390]],
+    123: [[2948281090, 2785986219], [3753851117, 1392532467]],
 }
 
 # Fault schedules for RaftMachine(5), queue_capacity=32,
 # FaultPlan(n_faults=2, t_max_us=3_000_000, dur 200_000..800_000):
-# event-queue rows [5, 9) of init_lane. Captured at PR-1 HEAD.
+# event-queue rows [5, 9) of init_lane. Re-captured under the pinned
+# partitionable lowering (PR-3).
 V1_FAULTS = FaultPlan(n_faults=2, t_max_us=3_000_000, dur_min_us=200_000, dur_max_us=800_000)
 V2_FAULTS = dataclasses.replace(
     V1_FAULTS, allow_dir_clog=True, allow_group=True, allow_storm=True
 )
 V1_SCHED = {
     7: {
-        "time": [1292254, 1837024, 2350629, 2928601],
+        "time": [2359908, 2901252, 2321832, 2529284],
         "seq": [5, 6, 7, 8],
-        "node": [1, 1, 4, 4],
-        "pay": [[0, 1, 0, 0, 0, 0], [1, 1, 0, 0, 0, 0],
-                [2, 4, 0, 0, 0, 0], [3, 4, 0, 0, 0, 0]],
+        "node": [2, 2, 2, 2],
+        "pay": [[2, 2, 0, 0, 0, 0], [3, 2, 0, 0, 0, 0],
+                [0, 2, 3, 0, 0, 0], [1, 2, 3, 0, 0, 0]],
     },
     123: {
-        "time": [66839, 444569, 858186, 1220446],
+        "time": [2025571, 2552840, 2104602, 2529175],
         "seq": [5, 6, 7, 8],
-        "node": [2, 2, 4, 4],
-        "pay": [[0, 2, 1, 0, 0, 0], [1, 2, 1, 0, 0, 0],
-                [2, 4, 2, 0, 0, 0], [3, 4, 2, 0, 0, 0]],
+        "node": [1, 1, 3, 3],
+        "pay": [[2, 1, 2, 0, 0, 0], [3, 1, 2, 0, 0, 0],
+                [2, 3, 2, 0, 0, 0], [3, 3, 2, 0, 0, 0]],
     },
 }
 V2_SCHED = {
     7: {
-        "time": [164039, 689732, 1502478, 1794064],
+        "time": [2359908, 2901252, 2321832, 2529284],
         "seq": [5, 6, 7, 8],
-        "node": [0, 0, 4, 4],
-        "pay": [[0, 0, 3, 0, 0, 0], [1, 0, 3, 0, 0, 0],
-                [6, 3, 0, 0, 0, 0], [7, 3, 0, 0, 0, 0]],
+        "node": [2, 2, 2, 2],
+        "pay": [[6, 17, 0, 0, 0, 0], [7, 17, 0, 0, 0, 0],
+                [0, 2, 3, 0, 0, 0], [1, 2, 3, 0, 0, 0]],
     },
     123: {
-        "time": [477089, 1179448, 2611921, 3379818],
+        "time": [2025571, 2552840, 2104602, 2529175],
         "seq": [5, 6, 7, 8],
-        "node": [0, 0, 4, 4],
-        "pay": [[4, 0, 3, 0, 0, 0], [5, 0, 3, 0, 0, 0],
-                [6, 3, 0, 0, 0, 0], [7, 3, 0, 0, 0, 0]],
+        "node": [1, 1, 3, 3],
+        "pay": [[4, 1, 2, 0, 0, 0], [5, 1, 2, 0, 0, 0],
+                [8, 52428, 2, 0, 0, 0], [9, 52428, 2, 0, 0, 0]],
     },
 }
 
 # v3 counter stream: same (4, 4, no-delay) config with kill enabled
 # => 10-word block [handler 4 | lat 4 | restart 2];
-# words(key, step) = threefry2x32(key, step*10 + iota(10)).
-# Pinned at introduction (this PR) — frozen from birth.
+# words(key, step) = threefry2x32(key, step*10 + iota(10)). The raw
+# threefry kernel is partitionable-independent, but the lane key above
+# it is not — re-captured with the pinned lowering (PR-3).
 V3_WORDS = {
     7: [
-        [469979567, 2630006822, 107867572, 521628325, 4058801364, 1224679957,
-         1947713326, 2661010368, 2099174757, 959740060],
-        [2393826230, 2916538718, 3536995759, 408775398, 3962656131, 2262925636,
-         1042797824, 2692833174, 3110079748, 3680617232],
+        [3728983260, 26083367, 2944131905, 213569972, 1554746844, 3940825189,
+         4057694018, 4138724339, 1091535129, 937531743],
+        [175129385, 3377294044, 3814277806, 394252965, 140491592, 1901111588,
+         1746438459, 257038357, 1010648607, 2318744050],
     ],
     123: [
-        [246548333, 331794331, 1710157904, 2746974178, 1470315740, 1879015273,
-         2684591198, 426354133, 1276734953, 972702624],
-        [3348752618, 3527090588, 2755500065, 3401051675, 1043462902, 2104391751,
-         163158707, 1090829266, 2278769389, 440881726],
+        [1663137049, 960457938, 1916282871, 736501441, 3805247166, 785596073,
+         1835670850, 3822876231, 582579697, 3441787572],
+        [2546113118, 3690581579, 3432516389, 4176221090, 321841896, 129854500,
+         3465149680, 1630024501, 952624321, 80431547],
     ],
 }
 

@@ -72,6 +72,27 @@ def test_pallas_pop_gate_bit_identical():
     _assert_results_equal(r_fused, r_xla)
 
 
+@pytest.mark.parametrize("cfg", [FULL_CHAOS, BENCH_LIKE], ids=["full-chaos", "bench-like"])
+@pytest.mark.parametrize("rng_stream", [2, 3], ids=["rng-v2", "rng-v3"])
+def test_flight_recorder_gate_off_bit_identical(cfg, rng_stream):
+    """The PR-3 flight recorder (digest fold + checkpoint ring + metric
+    counters in the step) must leave every simulation result bit-exactly
+    unchanged — recorder ON vs OFF, across both stream versions. The
+    gate-off path adds literally no ops (fr == {})."""
+    cfg = dataclasses.replace(cfg, rng_stream=rng_stream)
+    r_off = _run(Engine(_machine(), cfg))
+    r_on = _run(
+        Engine(
+            _machine(),
+            dataclasses.replace(
+                cfg, flight_recorder=True, fr_digest_every=32, fr_digest_ring=8
+            ),
+        )
+    )
+    _assert_results_equal(r_off, r_on)
+    assert r_off.fr == {} and r_on.fr  # recorder state only when gated on
+
+
 def test_rng_v3_stream_executor_and_replay_agree():
     """v3 results are executor-independent (batch vs stream) and the
     host replay reproduces a v3 device finding bit-identically — the

@@ -1,0 +1,114 @@
+"""Tracing satellites (PR-3): the @instrument span decorator's full
+surface (sync/async, exit and exception paths), the structured JSONL
+log sink, and the CLI --log-level wiring."""
+
+import asyncio
+import json
+import logging
+
+import pytest
+
+from madsim_tpu.tracing import JsonlHandler, SimContextFilter, init_tracing, instrument
+
+
+def test_instrument_async_entry_exit(caplog):
+    @instrument(level=logging.INFO)
+    async def work(x):
+        return x + 1
+
+    with caplog.at_level(logging.INFO):
+        assert asyncio.run(work(1)) == 2
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any(m.startswith("enter ") and "work" in m for m in msgs)
+    assert any(m.startswith("exit ") and "work" in m for m in msgs)
+
+
+def test_instrument_async_exception_logged_and_propagates(caplog):
+    @instrument(level=logging.INFO)
+    async def boom():
+        raise ValueError("kapow")
+
+    with caplog.at_level(logging.INFO):
+        with pytest.raises(ValueError, match="kapow"):
+            asyncio.run(boom())
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("exit" in m and "raised ValueError: kapow" in m for m in msgs)
+
+
+def test_instrument_sync_fn(caplog):
+    @instrument(name="span-name", level=logging.INFO)
+    def add(a, b):
+        return a + b
+
+    @instrument(level=logging.INFO)
+    def bad():
+        raise KeyError("nope")
+
+    with caplog.at_level(logging.INFO):
+        assert add(2, 3) == 5
+        with pytest.raises(KeyError):
+            bad()
+    msgs = [r.getMessage() for r in caplog.records]
+    assert "enter span-name" in msgs and "exit span-name" in msgs
+    assert any("raised KeyError" in m for m in msgs)
+    # functools.wraps preserved the wrapped function's identity
+    assert add.__name__ == "add"
+
+
+def test_jsonl_handler_writes_structured_lines(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    logger = logging.getLogger("test.jsonl.sink")
+    logger.setLevel(logging.DEBUG)
+    h = JsonlHandler(path)
+    h.addFilter(SimContextFilter())
+    logger.addHandler(h)
+    try:
+        logger.info("hello %s", "world")
+        logger.warning("watch out")
+    finally:
+        logger.removeHandler(h)
+        h.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2
+    assert lines[0]["msg"] == "hello world" and lines[0]["level"] == "INFO"
+    assert lines[1]["level"] == "WARNING"
+    # outside a simulation the sim span context is "-"
+    assert lines[0]["sim"] == "-"
+    assert {"ts", "level", "logger", "sim", "msg"} <= set(lines[0])
+
+
+def test_init_tracing_installs_jsonl_sink(tmp_path):
+    path = str(tmp_path / "root.jsonl")
+    root = logging.getLogger()
+    before = list(root.handlers)
+    try:
+        init_tracing("INFO", jsonl_path=path)
+        logging.getLogger("some.module").info("ping")
+    finally:
+        for h in root.handlers[len(before):]:
+            h.close()
+        root.handlers[:] = before
+    lines = [json.loads(l) for l in open(path)]
+    assert any(l["msg"] == "ping" for l in lines)
+
+
+def test_cli_log_level_wiring(tmp_path, capsys):
+    """--log-jsonl on any subcommand installs the sink via main()."""
+    from madsim_tpu.__main__ import main
+
+    path = str(tmp_path / "cli.jsonl")
+    root = logging.getLogger()
+    before = list(root.handlers)
+    try:
+        rc = main([
+            "replay", "--machine", "echo", "--seed", "0", "--faults", "0",
+            "--max-steps", "50", "--tail", "1",
+            "--log-level", "INFO", "--log-jsonl", path,
+        ])
+        logging.getLogger("cli.test").info("wired")
+    finally:
+        for h in root.handlers[len(before):]:
+            h.close()
+        root.handlers[:] = before
+    assert rc == 0
+    assert any(json.loads(l)["msg"] == "wired" for l in open(path))
