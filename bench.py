@@ -150,6 +150,12 @@ def main() -> None:
     # step (the acceptance bar: < 5% vs the recorder-off r6 capture);
     # =0 for an A/B.
     flight_recorder = os.environ.get("MADSIM_TPU_FLIGHT_RECORDER", "1") not in ("", "0")
+    # Scenario coverage (PR-4 observability gate): default ON for the
+    # same reason — the flagship number is captured with the full
+    # observability stack riding the step (budget: recorder+coverage ON
+    # within 5% of the r07 capture; the vs_r07 field below is the
+    # receipt). =0 for an A/B.
+    coverage = os.environ.get("MADSIM_TPU_COVERAGE", "1") not in ("", "0")
     cfg = EngineConfig(
         horizon_us=5_000_000,
         # 32 slots: the real-chip queue sweep (PROFILE_r2.md) — the [L, Q]
@@ -161,6 +167,7 @@ def main() -> None:
         rng_stream=rng_stream,
         clog_packed=clog_packed,
         flight_recorder=flight_recorder,
+        coverage=coverage,
     )
     eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
 
@@ -234,6 +241,39 @@ def main() -> None:
             step_cost["flight_recorder_off"] = one_rate(
                 Engine(eng.machine, dataclasses.replace(cfg, flight_recorder=False))
             )
+        if cfg.coverage:
+            step_cost["coverage_off"] = one_rate(
+                Engine(eng.machine, dataclasses.replace(cfg, coverage=False))
+            )
+
+    # 5%-budget receipt vs the r07 flagship capture (recorder ON,
+    # coverage predates). Only comparable when the run SHAPE matches the
+    # recorded one (same lanes, same platform) — CI's tiny 512-lane
+    # capture must not false-alarm. MADSIM_TPU_BENCH_ENFORCE_BUDGET=1
+    # turns a violation into a nonzero exit for gating jobs.
+    budget = None
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r07.json")) as f:
+            r07 = json.load(f)
+        if (
+            r07["diagnostics"]["lanes"] == lanes
+            and r07["platform"] == jax.devices()[0].platform
+        ):
+            ratio = seeds_per_sec / r07["value"]
+            budget = {
+                "vs_r07": round(ratio, 3),
+                "within_5pct": ratio >= 0.95,
+            }
+            if not budget["within_5pct"]:
+                print(
+                    f"bench: BUDGET VIOLATION — {seeds_per_sec:.1f} seeds/s "
+                    f"is {100 * (1 - ratio):.1f}% below the r07 capture "
+                    f"({r07['value']}) with the observability gates on",
+                    file=sys.stderr, flush=True,
+                )
+    except (OSError, KeyError, ValueError):
+        budget = None
 
     print(
         json.dumps(
@@ -242,6 +282,7 @@ def main() -> None:
                 "value": round(seeds_per_sec, 1),
                 "unit": "seeds/sec",
                 "vs_baseline": round(seeds_per_sec / per_chip_target, 3),
+                **({"budget": budget} if budget else {}),
                 "platform": jax.devices()[0].platform,
                 "backend": _BACKEND_INFO,
                 # one-time compile vs steady state, split (a cold process
@@ -256,6 +297,7 @@ def main() -> None:
                     "clog_packed": cfg.clog_packed,
                     "pallas_pop": eng.use_pallas_pop,
                     "flight_recorder": cfg.flight_recorder,
+                    "coverage": cfg.coverage,
                     "compile_cache": active_compile_cache(),
                 },
                 "diagnostics": {
@@ -281,11 +323,29 @@ def main() -> None:
                         {"flight_recorder": stream_stats["flight_recorder"]}
                         if "flight_recorder" in stream_stats else {}
                     ),
+                    # scenario-coverage summary (last rep; curve omitted
+                    # to keep the JSON line one-screen)
+                    **(
+                        {
+                            "coverage": {
+                                k: v
+                                for k, v in stream_stats["coverage"].items()
+                                if k != "curve"
+                            }
+                        }
+                        if "coverage" in stream_stats else {}
+                    ),
                     **({"step_cost": step_cost} if step_cost else {}),
                 },
             }
         )
     )
+    if (
+        budget is not None
+        and not budget["within_5pct"]
+        and os.environ.get("MADSIM_TPU_BENCH_ENFORCE_BUDGET", "") not in ("", "0")
+    ):
+        sys.exit(1)
 
 
 if __name__ == "__main__":

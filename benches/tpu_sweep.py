@@ -35,6 +35,10 @@ def run_point(batch: int, segment_steps: int) -> dict:
         # step-path gates (same env overrides as bench.py; defaults = on)
         rng_stream=int(os.environ.get("MADSIM_TPU_RNG_STREAM", "3")),
         clog_packed=os.environ.get("MADSIM_TPU_CLOG_PACKED", "1") not in ("", "0"),
+        # observability gates ride the sweep like the flagship bench
+        flight_recorder=os.environ.get("MADSIM_TPU_FLIGHT_RECORDER", "1")
+        not in ("", "0"),
+        coverage=os.environ.get("MADSIM_TPU_COVERAGE", "1") not in ("", "0"),
     )
     eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
     # pipelined-executor knobs (round-6), env-tunable for A/B sweeps:
@@ -69,6 +73,15 @@ def run_point(batch: int, segment_steps: int) -> dict:
         "device_segments": st["device_segments"],
         "pipelined": st["pipelined"],
         "donation": st["donation"],
+        "flight_recorder": cfg.flight_recorder,
+        **(
+            {
+                "coverage": {
+                    k: v for k, v in st["coverage"].items() if k != "curve"
+                }
+            }
+            if "coverage" in st else {}
+        ),
     }
 
 
@@ -84,8 +97,21 @@ def main() -> None:
             (8192, 384),
             (16384, 384),
         ]
+    # long sweeps are observable from outside the process: with
+    # MADSIM_TPU_STATS=base set, every point also lands in base.jsonl +
+    # the base.prom / base.json snapshots (`serve --service stats`)
+    emitter = None
+    if os.environ.get("MADSIM_TPU_STATS"):
+        from madsim_tpu.tracing import StatsEmitter
+
+        emitter = StatsEmitter(os.environ["MADSIM_TPU_STATS"])
     for batch, seg in grid:
-        print(json.dumps(run_point(batch, seg)), flush=True)
+        point = run_point(batch, seg)
+        print(json.dumps(point), flush=True)
+        if emitter is not None:
+            emitter.emit({"kind": "sweep_point", **point})
+    if emitter is not None:
+        emitter.close()
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import logging
 import os
 import sys
 
@@ -128,6 +129,7 @@ def _build_engine(args):
         packet_loss_rate=args.loss,
         rng_stream=getattr(args, "rng_stream", 2),
         flight_recorder=bool(getattr(args, "flight_recorder", False)),
+        coverage=bool(getattr(args, "coverage", False)),
         compile_cache_dir=getattr(args, "compile_cache", None),
         faults=FaultPlan(
             n_faults=args.faults,
@@ -201,6 +203,211 @@ def _print_fr_stats(stats) -> None:
     )
 
 
+def _make_emitter(args):
+    """StatsEmitter bound to --stats BASE (also $MADSIM_TPU_STATS):
+    BASE.jsonl (history), BASE.prom (Prometheus textfile), BASE.json
+    (latest snapshot — what `serve --service stats` exposes)."""
+    base = getattr(args, "stats", None) or os.environ.get("MADSIM_TPU_STATS")
+    if not base:
+        return None
+    from .tracing import StatsEmitter
+
+    return StatsEmitter(base)
+
+
+def _print_cov_stats(stats) -> None:
+    """One coverage line when the map rode the stream."""
+    cov = stats.get("coverage")
+    if not cov:
+        return
+    bands = ", ".join(f"{k}={v}" for k, v in cov["by_band"].items() if v)
+    print(
+        f"coverage: {cov['slots_hit']}/{cov['slots_total']} slots "
+        f"({100 * cov['fraction']:.2f}%) [{bands or 'none'}]"
+    )
+
+
+def _stream_batches(eng, args, purpose="explore"):
+    """Chunked streaming driver shared by explore/hunt: run the seed
+    budget as batches of `--batch` seeds (each one run_stream call), so
+    long hunts are observable — a heartbeat log line per batch (at
+    --log-level info), a StatsEmitter record per batch (--stats), a
+    cumulative coverage map, and the `--stop-on-plateau N` early exit
+    when N consecutive batches add zero new coverage slots.
+
+    Returns an aggregate dict shaped like run_stream's result, plus
+    "batches_run"/"batches_planned"/"plateau"/"elapsed_s" (and
+    "coverage_map" when the engine's coverage gate is on).
+    """
+    import numpy as np
+    import time as wall
+
+    log = logging.getLogger(f"madsim_tpu.{purpose}")
+    emitter = _make_emitter(args)
+    plateau_n = int(getattr(args, "stop_on_plateau", 0) or 0)
+    detector = None
+    if plateau_n:
+        if not getattr(args, "coverage", False):
+            sys.exit(
+                "--stop-on-plateau needs --coverage: the plateau signal "
+                "IS the coverage curve"
+            )
+        from .runtime.coverage import PlateauDetector
+
+        detector = PlateauDetector(plateau_n)
+
+    sk = _stream_kwargs(args)
+    batch = min(args.seeds, args.batch)
+    planned = -(-args.seeds // batch)  # ceil
+    # compile + warm outside the timed loop (same discipline as before)
+    eng.run_stream(1, batch=batch, segment_steps=384, max_steps=args.max_steps, **sk)
+
+    agg = {
+        "completed": 0,
+        "failing": [],
+        "infra": [],
+        "abandoned": [],
+        "seeds_consumed": 0,
+        "stats": {},
+    }
+    cov_map = None
+    cursor = args.seed
+    plateaued = False
+    t_start = wall.perf_counter()
+    bi = -1
+    for bi in range(planned):
+        chunk = min(batch, args.seeds - agg["completed"])
+        if chunk <= 0:
+            break
+        t0 = wall.perf_counter()
+        out = eng.run_stream(
+            chunk, batch=min(batch, chunk), segment_steps=384,
+            seed_start=cursor, max_steps=args.max_steps, **sk,
+        )
+        el = max(wall.perf_counter() - t0, 1e-9)
+        cursor += out["seeds_consumed"]
+        agg["completed"] += out["completed"]
+        agg["seeds_consumed"] += out["seeds_consumed"]
+        agg["failing"].extend(out["failing"])
+        agg["infra"].extend(out["infra"])
+        agg["abandoned"].extend(out["abandoned"])
+        agg["stats"] = out["stats"]
+        new_slots = 0
+        slots_hit = 0
+        if "coverage_map" in out:
+            m = np.asarray(out["coverage_map"])
+            prev = 0 if cov_map is None else int(cov_map.sum())
+            cov_map = m if cov_map is None else (cov_map | m)
+            slots_hit = int(cov_map.sum())
+            new_slots = slots_hit - prev
+        cov_txt = (
+            f", coverage {slots_hit} slots (+{new_slots})"
+            if cov_map is not None else ""
+        )
+        log.info(
+            "batch %d/%d: %d seeds in %.1fs (%.0f seeds/s), "
+            "%d failing so far, %d infra, %d abandoned%s",
+            bi + 1, planned, out["completed"], el, out["completed"] / el,
+            len(agg["failing"]), len(agg["infra"]), len(agg["abandoned"]),
+            cov_txt,
+        )
+        if emitter is not None:
+            rec = {
+                "kind": f"{purpose}_batch",
+                "machine": args.machine,
+                "batch": bi + 1,
+                "batches": planned,
+                "completed": agg["completed"],
+                "batch_completed": out["completed"],
+                "seeds_per_sec": round(out["completed"] / el, 1),
+                "failing": len(agg["failing"]),
+                "infra": len(agg["infra"]),
+                "abandoned": len(agg["abandoned"]),
+            }
+            if cov_map is not None:
+                rec["coverage"] = {
+                    "slots_hit": slots_hit, "new_slots": new_slots,
+                }
+            if "flight_recorder" in out["stats"]:
+                rec["flight_recorder"] = out["stats"]["flight_recorder"]
+            emitter.emit(rec)
+        if detector is not None and detector.update(slots_hit):
+            plateaued = True
+            log.info(
+                "coverage plateau: no new slots for %d consecutive "
+                "batches — stopping after batch %d/%d",
+                plateau_n, bi + 1, planned,
+            )
+            break
+
+    agg["elapsed_s"] = wall.perf_counter() - t_start
+    agg["batches_run"] = bi + 1
+    agg["batches_planned"] = planned
+    agg["plateau"] = plateaued
+    if cov_map is not None:
+        agg["coverage_map"] = cov_map
+        from .runtime.coverage import coverage_dict
+
+        agg["stats"] = dict(agg["stats"])
+        agg["stats"]["coverage"] = {
+            **coverage_dict(cov_map, eng.config.cov_slots_log2),
+            "plateau": plateaued,
+            "plateau_patience": plateau_n,
+        }
+    if emitter is not None:
+        emitter.emit(
+            {
+                "kind": f"{purpose}_summary",
+                "machine": args.machine,
+                "completed": agg["completed"],
+                "failing": len(agg["failing"]),
+                "infra": len(agg["infra"]),
+                "abandoned": len(agg["abandoned"]),
+                "batches_run": agg["batches_run"],
+                "batches_planned": planned,
+                "plateau": plateaued,
+                "elapsed_s": round(agg["elapsed_s"], 2),
+                **(
+                    {"coverage": agg["stats"]["coverage"]}
+                    if cov_map is not None else {}
+                ),
+            }
+        )
+        emitter.close()
+    return agg
+
+
+def _write_coverage_out(eng, args, agg) -> None:
+    """`hunt --coverage-out PATH`: persist the cumulative map for
+    cross-run diffing (`madsim_tpu coverage PATH --diff OLD`)."""
+    path = getattr(args, "coverage_out", None)
+    if not path:
+        return
+    if "coverage_map" not in agg:
+        sys.exit("--coverage-out needs --coverage and --stream")
+    import time as wall
+
+    from .runtime.coverage import make_coverage_doc, save_coverage_doc
+
+    doc = make_coverage_doc(
+        {args.machine: agg["coverage_map"]},
+        eng.config.cov_slots_log2,
+        meta={
+            "seeds": args.seeds,
+            "seed_start": args.seed,
+            "completed": agg["completed"],
+            "fault_kinds": getattr(args, "fault_kinds", "pair,kill"),
+            "ts": round(wall.time(), 3),
+        },
+    )
+    save_coverage_doc(path, doc)
+    cov = agg["stats"]["coverage"]
+    print(
+        f"coverage map: {cov['slots_hit']}/{cov['slots_total']} slots "
+        f"-> {path}"
+    )
+
+
 def _split_infra(failing):
     """Partition (seed, code) pairs into (findings, infra): OVERFLOW is
     a fixed-shape capacity abort — an infrastructure artifact that says
@@ -213,17 +420,14 @@ def _split_infra(failing):
     return findings, infra
 
 
-def _find_failing(eng, args):
+def _find_failing(eng, args, purpose="hunt"):
     """Run the seed batch (streaming or fixed) and return
     (failing [(seed, code), ...], infra [(seed, code), ...],
-    abandoned_count, stream_stats)."""
+    abandoned_count, aggregate) where aggregate is _stream_batches'
+    result dict (empty for the fixed path)."""
     if args.stream:
-        out = eng.run_stream(
-            args.seeds, batch=min(args.seeds, args.batch), segment_steps=384,
-            seed_start=args.seed, max_steps=args.max_steps,
-            **_stream_kwargs(args),
-        )
-        return out["failing"], out["infra"], len(out["abandoned"]), out["stats"]
+        agg = _stream_batches(eng, args, purpose=purpose)
+        return agg["failing"], agg["infra"], len(agg["abandoned"]), agg
     import jax.numpy as jnp
 
     seeds = jnp.arange(args.seed, args.seed + args.seeds, dtype=jnp.uint32)
@@ -234,7 +438,7 @@ def _find_failing(eng, args):
             eng.failing_seeds(res).tolist(), res.fail_code[res.failed].tolist()
         )
     )
-    return failing, infra, 0, {}
+    return failing, infra, 0, {"stats": {}}
 
 
 def cmd_explore(args) -> int:
@@ -268,25 +472,24 @@ def cmd_explore(args) -> int:
     eng = _build_engine(args)
     if args.stream:
         # seed streaming: finished lanes refill with fresh seeds — the
-        # high-throughput path for large batches (bench.py's path)
-        import time as wall
-
-        batch = min(args.seeds, args.batch)
-        sk = _stream_kwargs(args)
-        eng.run_stream(1, batch=batch, segment_steps=384, max_steps=args.max_steps, **sk)
-        t0 = wall.perf_counter()
-        out = eng.run_stream(
-            args.seeds, batch=batch, segment_steps=384,
-            seed_start=args.seed, max_steps=args.max_steps, **sk,
-        )
-        el = wall.perf_counter() - t0
+        # high-throughput path for large batches (bench.py's path),
+        # chunked into --batch-seed batches so long runs heartbeat,
+        # emit stats and can stop on a coverage plateau
+        out = _stream_batches(eng, args, purpose="explore")
+        el = out["elapsed_s"]
         failing = out["failing"]
         st = out["stats"]
+        plateau_txt = (
+            f" [stopped early: coverage plateau after batch "
+            f"{out['batches_run']}/{out['batches_planned']}]"
+            if out["plateau"] else ""
+        )
         print(
             f"streamed {out['completed']} seeds in {el:.1f}s "
-            f"({out['completed']/el:.0f} seeds/s), {len(failing)} failing, "
+            f"({out['completed']/max(el, 1e-9):.0f} seeds/s), {len(failing)} failing, "
             f"{len(out['abandoned'])} abandoned"
             + (f", {len(out['infra'])} infra (queue overflow)" if out["infra"] else "")
+            + plateau_txt
         )
         print(
             f"executor: {st['device_segments']} segments, "
@@ -295,6 +498,7 @@ def cmd_explore(args) -> int:
             f"depth={st['dispatch_depth']}x{st['segments_per_dispatch']})"
         )
         _print_fr_stats(st)
+        _print_cov_stats(st)
         if failing:
             codes = sorted({c for _s, c in failing})
             print(f"failure codes: {codes}")
@@ -310,6 +514,18 @@ def cmd_explore(args) -> int:
     n_done = int(res.done.sum())
     print(f"explored {len(seeds.tolist())} seeds ({n_done} completed), "
           f"{len(failing)} failing")
+    if getattr(args, "coverage", False):
+        import numpy as np
+
+        from .runtime.coverage import coverage_dict, unpack_map
+
+        m = unpack_map(
+            np.bitwise_or.reduce(np.asarray(res.cov["map"]), axis=0),
+            eng.config.cov_slots_log2,
+        )
+        _print_cov_stats(
+            {"coverage": coverage_dict(m, eng.config.cov_slots_log2)}
+        )
     if failing:
         codes = sorted({int(c) for c in res.fail_code.tolist() if c != 0})
         print(f"failure codes: {codes}")
@@ -325,17 +541,30 @@ def cmd_hunt(args) -> int:
     from .engine import audit, corpus, shrink
 
     eng = _build_engine(args)
-    failing, infra, abandoned, stream_stats = _find_failing(eng, args)
+    failing, infra, abandoned, agg = _find_failing(eng, args, purpose="hunt")
+    stream_stats = agg.get("stats", {})
+    hunted = agg.get("completed", args.seeds)
+    plateau_txt = ""
+    if agg.get("plateau"):
+        # honest reporting: a plateaued hunt ran FEWER seeds than asked
+        plateau_txt = (
+            f" [coverage plateau: stopped after batch "
+            f"{agg['batches_run']}/{agg['batches_planned']} — "
+            f"{max(0, args.seeds - hunted)} budgeted seeds not run]"
+        )
     print(
-        f"hunted {args.seeds} seeds: {len(failing)} failing"
+        f"hunted {hunted} seeds: {len(failing)} failing"
         + (f", {abandoned} abandoned (over --max-steps)" if abandoned else "")
         + (
             f", {len(infra)} infra artifacts (queue overflow — rerun "
             f"with a bigger --queue; not recorded as findings)"
             if infra else ""
         )
+        + plateau_txt
     )
     _print_fr_stats(stream_stats)
+    _print_cov_stats(stream_stats)
+    _write_coverage_out(eng, args, agg)
     entries = corpus.load(args.corpus)
     known = {e.key for e in entries}
     added = 0
@@ -581,6 +810,80 @@ def cmd_check(args) -> int:
     return 0
 
 
+def cmd_coverage(args) -> int:
+    """Render a persisted coverage map (`hunt --coverage-out`): total
+    slots hit, per-band (event class / fault kind) marginals, the
+    thinnest (band x model-phase) cells — the steer-here signal — and,
+    with --diff, what a second run added over the first. Pure host-side
+    numpy: works without an accelerator stack."""
+    from .runtime.coverage import load_coverage_doc, render_report
+
+    try:
+        doc = load_coverage_doc(args.doc)
+        diff_doc = load_coverage_doc(args.diff) if args.diff else None
+    except (OSError, ValueError, KeyError) as exc:
+        sys.exit(f"coverage: {exc}")
+    print(render_report(doc, top=args.top, diff_doc=diff_doc))
+    return 0
+
+
+def _serve_stats(args) -> int:
+    """`serve --service stats`: a tiny HTTP endpoint over the
+    StatsEmitter's files — GET /stats returns the latest run snapshot
+    (BASE.json), GET /metrics the Prometheus textfile (BASE.prom) — so
+    dashboards poll an endpoint instead of parsing logs. Plain stdlib
+    http.server; read-only; no sim/jax imports."""
+    import http.server
+
+    base = args.stats or os.environ.get("MADSIM_TPU_STATS") or "madsim_stats"
+    routes = {
+        "/stats": (base + ".json", "application/json"),
+        "/metrics": (base + ".prom", "text/plain; version=0.0.4"),
+    }
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API name)
+            path = self.path.split("?", 1)[0].rstrip("/") or "/stats"
+            if path == "/healthz":
+                body, ctype = b"ok\n", "text/plain"
+            elif path in routes:
+                fname, ctype = routes[path]
+                try:
+                    with open(fname, "rb") as f:
+                        body = f.read()
+                except OSError:
+                    self.send_error(
+                        404, f"no stats recorded yet ({fname} missing)"
+                    )
+                    return
+            else:
+                self.send_error(404, "routes: /stats /metrics /healthz")
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *a):  # route access logs to logging
+            logging.getLogger("madsim_tpu.serve").debug(fmt, *a)
+
+    host, port = args.addr.rsplit(":", 1)
+    srv = http.server.ThreadingHTTPServer((host, int(port)), Handler)
+    print(
+        f"stats serving on {host}:{srv.server_address[1]} "
+        f"(GET /stats /metrics /healthz; files {base}.json/.prom)",
+        flush=True,
+    )
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Run an L5 service server over real TCP (production mode) — the
     counterpart of the reference's real etcd/kafka/S3 endpoints. Apps
@@ -589,6 +892,10 @@ def cmd_serve(args) -> int:
     SECURITY: the wire format is pickle (like the reference real-mode
     Endpoint uses bincode, but pickle can execute code on load) — bind
     only on trusted networks / localhost."""
+    if args.service == "stats":
+        # observability endpoint over StatsEmitter files: no sim
+        # networking involved, so no real-mode requirement
+        return _serve_stats(args)
     from . import dual
 
     if dual.MODE != "real":
@@ -791,6 +1098,21 @@ def main(argv=None) -> int:
             "+ checkpoint ring + on-device fault/queue metrics (results "
             "are bit-identical either way; see `audit`)",
         )
+        p.add_argument(
+            "--coverage", action="store_true",
+            help="scenario-coverage telemetry: per-lane AFL-style hit "
+            "maps over (model abstract state, event kind, fault "
+            "context), OR-reduced on device at stream harvest (results "
+            "are bit-identical either way; enables --stop-on-plateau "
+            "and `coverage` reports)",
+        )
+        p.add_argument(
+            "--stats", default=None, metavar="BASE",
+            help="StatsEmitter base path (also $MADSIM_TPU_STATS): "
+            "stream per-batch stats to BASE.jsonl + Prometheus textfile "
+            "BASE.prom + latest-snapshot BASE.json (what `serve "
+            "--service stats` exposes)",
+        )
 
     def stream_flags(p):
         """Pipelined streaming-executor knobs (explore/hunt/bench)."""
@@ -811,6 +1133,13 @@ def main(argv=None) -> int:
             "--no-donate", action="store_true",
             help="disable StreamCarry buffer donation (keeps the r5 "
             "copy-per-call behavior; results are bit-identical either way)",
+        )
+        p.add_argument(
+            "--stop-on-plateau", type=int, default=0, metavar="N",
+            help="with --coverage: stop the run early when N consecutive "
+            "seed batches add zero new coverage slots (the saturation "
+            "signal — more seeds are no longer finding new scenarios); "
+            "reported honestly in the summary",
         )
 
     p = sub.add_parser("explore", help="run a seed batch, report failing seeds")
@@ -874,6 +1203,12 @@ def main(argv=None) -> int:
     p.add_argument("--corpus", default="corpus.json")
     p.add_argument("--limit", type=int, default=5, help="max seeds to shrink+record")
     p.add_argument(
+        "--coverage-out", default=None, metavar="PATH",
+        help="with --coverage --stream: persist the hunt's cumulative "
+        "coverage map as JSON for cross-run diffing "
+        "(`madsim_tpu coverage PATH --diff OLD`)",
+    )
+    p.add_argument(
         "--all-seeds",
         action="store_true",
         help="shrink the first --limit failing seeds even when they share "
@@ -932,12 +1267,35 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_bench, machine=None, seed=1_000_000)
 
     p = sub.add_parser(
+        "coverage",
+        help="render a persisted scenario-coverage map (total %%, "
+        "per-band marginals, thinnest fault x phase cells, per-model "
+        "breakdown); --diff OLD shows what a run added over another",
+    )
+    p.add_argument("doc", help="coverage JSON written by `hunt --coverage-out`")
+    p.add_argument(
+        "--diff", default=None, metavar="OLD",
+        help="baseline coverage doc to diff against (new/lost/shared slots)",
+    )
+    p.add_argument("--top", type=int, default=8,
+                   help="thinnest band x phase cells to list")
+    p.set_defaults(fn=cmd_coverage)
+
+    p = sub.add_parser(
         "serve",
         help="run an L5 service over real TCP (MADSIM_TPU_MODE=real); "
-        "pickle wire format — trusted networks only",
+        "pickle wire format — trusted networks only. `--service stats` "
+        "serves the last run's StatsEmitter snapshot over HTTP instead "
+        "(/stats JSON + /metrics Prometheus; any mode)",
     )
-    p.add_argument("--service", default="etcd", choices=["etcd", "kafka", "s3"])
+    p.add_argument("--service", default="etcd",
+                   choices=["etcd", "kafka", "s3", "stats"])
     p.add_argument("--addr", default="127.0.0.1:23790", help="host:port (port 0 = ephemeral)")
+    p.add_argument(
+        "--stats", default=None, metavar="BASE",
+        help="stats service only: StatsEmitter base path to serve "
+        "(default $MADSIM_TPU_STATS or ./madsim_stats)",
+    )
     p.add_argument(
         "--grpc",
         action="store_true",
@@ -981,7 +1339,7 @@ def main(argv=None) -> int:
         from .parallel import multihost
 
         multihost.initialize()
-    elif args.cmd != "serve":  # serve never touches jax — skip the probe
+    elif args.cmd not in ("serve", "coverage"):  # no jax — skip the probe
         from ._backend_watchdog import ensure_live_backend
 
         cli_args = list(argv) if argv is not None else sys.argv[1:]

@@ -19,6 +19,11 @@ See `core.py` for the architecture. Public surface:
     digests + checkpoint ring + on-device fault/queue metrics;
     `audit.collect_trail` / `audit.first_divergence` bisect two trails
     to the first divergent checkpoint (audit.py)
+  * `EngineConfig(coverage=True)` — scenario-coverage telemetry:
+    per-lane AFL-style hit maps over (model projection, event kind,
+    fault context), OR-reduced at stream harvest into
+    `stats["coverage"]` (ops/coverage.py device side,
+    runtime/coverage.py host side: plateau policy, persistence, diff)
 """
 
 from .core import (

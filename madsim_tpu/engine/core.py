@@ -38,6 +38,12 @@ import os
 
 from ..compile_cache import enable_compile_cache
 from ..ops import find_free_slot, pop_earliest
+from ..ops.coverage import (
+    COV_SLOTS_LOG2_DEFAULT,
+    cov_fold,
+    cov_slot,
+    empty_cov_map,
+)
 from ..ops.pallas_pop import HAVE_PALLAS, pop_earliest_batch, pop_gather_batch
 from ..ops.step_rng import (
     RNG_STREAM_COUNTER,
@@ -259,6 +265,20 @@ class EngineConfig:
     flight_recorder: bool = False
     fr_digest_every: int = 64  # steps between digest checkpoints
     fr_digest_ring: int = 32  # checkpoints retained per lane (ring)
+    # Scenario-coverage telemetry (observability): every popped event
+    # hashes (model abstract-state projection, event kind, fault
+    # context) into a per-lane AFL-style uint8 saturating-count map
+    # (ops/coverage.py; 2^cov_slots_log2 slots, banded
+    # [band|phase|mix] layout so the host can decode per-fault-kind and
+    # per-phase marginals). The stream harvest OR-reduces lanes into one
+    # device vector — zero extra host syncs, same discipline as the
+    # flight recorder — and run_stream stats gain "coverage" (slots
+    # hit / fraction / curve). The signal behind `--stop-on-plateau`:
+    # a hunt that stops adding slots has saturated its scenario space.
+    # Gate-off is bit-identical (tests assert); ON is also
+    # result-identical — the map is write-only telemetry.
+    coverage: bool = False
+    cov_slots_log2: int = COV_SLOTS_LOG2_DEFAULT
     # Opt-in JAX persistent compilation cache directory (also
     # $MADSIM_TPU_COMPILE_CACHE): hunts and sweeps pay each multi-second
     # compile once per machine instead of once per process. Host-side
@@ -292,6 +312,7 @@ class LaneState:
     nodes: Any
     ring: Any  # {} when trace_ring == 0, else dict of [R]/[R,P] arrays
     fr: Any  # {} unless flight_recorder: digest + checkpoint ring + metrics
+    cov: Any  # {} unless coverage: {"map": int32[2^cov_slots_log2 / 32] bit words}
 
 
 @struct.dataclass
@@ -312,8 +333,9 @@ class StreamCarry:
     fail_count: jax.Array  # int32 scalar
     ab_seeds: jax.Array  # uint32[C]
     ab_count: jax.Array  # int32 scalar
-    counters: jax.Array  # uint32[6]: completed, fail_count, ab_count, next_seed, flags, segments
+    counters: jax.Array  # uint32[7]: completed, fail_count, ab_count, next_seed, flags, segments, cov_slots_hit
     fr_metrics: jax.Array  # int32[FR_METRICS_LEN]: flight-recorder totals (zeros when off)
+    cov_map: jax.Array  # int32[2^cov_slots_log2 / 32] global OR of lane bit maps ([0] when off)
 
 
 @struct.dataclass
@@ -328,6 +350,7 @@ class BatchResult:
     summary: Any
     ring: Any  # per-lane event rings ({} unless config.trace_ring > 0)
     fr: Any  # per-lane flight-recorder state ({} unless flight_recorder)
+    cov: Any  # per-lane coverage maps ({} unless config.coverage)
 
 
 class Engine:
@@ -401,6 +424,12 @@ class Engine:
             raise ValueError(
                 "flight_recorder needs fr_digest_every >= 1 and "
                 "fr_digest_ring >= 1"
+            )
+        if config.coverage and not 7 <= config.cov_slots_log2 <= 20:
+            raise ValueError(
+                "coverage needs 7 <= cov_slots_log2 <= 20 (3 band bits "
+                "+ 3 phase bits + at least 1 mix bit; 2^20 slots = 1 MiB "
+                "per lane is already past any sane map size)"
             )
         # Static step-RNG block layout + compute-elision flags: which
         # chaos draws this (config, machine) pair can ever consume.
@@ -554,7 +583,14 @@ class Engine:
             nodes=nodes,
             ring=self._empty_ring(),
             fr=self._empty_fr(),
+            cov=self._empty_cov(),
         )
+
+    def _empty_cov(self):
+        """Fresh coverage state: a zeroed per-lane hit map."""
+        if not self.config.coverage:
+            return {}
+        return {"map": empty_cov_map(self.config.cov_slots_log2)}
 
     def _empty_fr(self):
         """Fresh flight-recorder state: digest at its IV, empty
@@ -944,6 +980,36 @@ class Engine:
                 ),
             }
 
+        # -- scenario coverage (observability; gate-off adds NO ops) --------
+        cov = s.cov
+        if cfg.coverage:
+            # abstract-state projection of the POST-step state: the
+            # scenario this event's processing REACHED (the model
+            # contract: Machine.coverage_projection, low 3 bits = its
+            # coarsest "phase" notion)
+            abs_word = m.coverage_projection(nodes, new_now)
+            # fault-environment context: killed count + active chaos
+            # windows — the same abstract state under partition vs storm
+            # is a different scenario
+            n_killed = jnp.clip(killed.sum().astype(jnp.int32), 0, 7)
+            clog_any = jnp.any(clogged != 0)
+            ctx = (
+                n_killed
+                | (clog_any.astype(jnp.int32) << 3)
+                | ((storm_loss > 0).astype(jnp.int32) << 4)
+                | ((delay_spike > 0).astype(jnp.int32) << 5)
+            )
+            # event discriminant: payload[0] for msg (message type) and
+            # fault (op) events; timers fold 0 — timer ids are
+            # epoch-encoded, and counting every restart epoch as a new
+            # scenario would inflate the map
+            op_word = jnp.where(ev_kind == EV_TIMER, jnp.int32(0), ev_payload[0])
+            slot = cov_slot(
+                abs_word, ev_kind, ev_node, op_word, ctx, cfg.cov_slots_log2
+            )
+            # same condition as the trace ring / digest: popped events
+            cov = {"map": cov_fold(cov["map"], slot, live)}
+
         # -- invariants / termination ---------------------------------------
         ok, code = m.invariant(nodes, new_now)
         inv_fail = process & ~ok
@@ -983,6 +1049,7 @@ class Engine:
             nodes=nodes,
             ring=ring,
             fr=fr,
+            cov=cov,
         )
 
     # -- batch runners -------------------------------------------------------
@@ -1034,6 +1101,7 @@ class Engine:
             summary=jax.vmap(self.machine.summary)(final.nodes),
             ring=final.ring,
             fr=final.fr,
+            cov=final.cov,
         )
 
     def run_segment(self, state: LaneState, segment_steps: int) -> LaneState:
@@ -1120,6 +1188,11 @@ class Engine:
                     c.next_seed,
                     over.astype(jnp.uint32),
                     c.segments.astype(jnp.uint32),
+                    # global coverage slots hit (0 when the gate is off —
+                    # the empty map popcounts to 0): rides the one small
+                    # counters transfer the host polls anyway, so the
+                    # live coverage curve costs zero extra syncs
+                    lax.population_count(c.cov_map).sum(dtype=jnp.uint32),
                 ]
             )
 
@@ -1136,8 +1209,13 @@ class Engine:
                 fail_count=jnp.int32(0),
                 ab_seeds=jnp.zeros((cap,), jnp.uint32),
                 ab_count=jnp.int32(0),
-                counters=jnp.zeros((6,), jnp.uint32),
+                counters=jnp.zeros((7,), jnp.uint32),
                 fr_metrics=jnp.zeros((FR_METRICS_LEN,), jnp.int32),
+                cov_map=(
+                    empty_cov_map(self.config.cov_slots_log2)
+                    if self.config.coverage
+                    else jnp.zeros((0,), jnp.int32)
+                ),
             )
             return c.replace(counters=_counters(c))
 
@@ -1204,6 +1282,17 @@ class Engine:
                 )
                 fr_metrics = jnp.concatenate([inj_tot, hwm])
 
+            # coverage rides the harvest too: OR every lane's bit map
+            # into the global vector. ALL lanes, not just done ones —
+            # lane maps are monotone (bits only set), so the fold is
+            # idempotent and in-flight lanes contribute their partial
+            # coverage to the live curve the host polls.
+            cov_map = c.cov_map
+            if self.config.coverage:
+                cov_map = cov_map | lax.reduce(
+                    state.cov["map"], jnp.int32(0), lax.bitwise_or, (0,)
+                )
+
             new = StreamCarry(
                 state=state,
                 seeds=seeds,
@@ -1218,6 +1307,7 @@ class Engine:
                 ab_count=ab_count,
                 counters=c.counters,
                 fr_metrics=fr_metrics,
+                cov_map=cov_map,
             )
             return new.replace(counters=_counters(new))
 
@@ -1300,7 +1390,12 @@ class Engine:
         queue-capacity aborts, not protocol findings), "abandoned":
         [seed...], "seeds_consumed", "stats": {host_syncs, drains,
         dispatches, device_segments, dispatch_depth,
-        segments_per_dispatch, donation, pipelined}}.
+        segments_per_dispatch, donation, pipelined}}. With
+        `config.coverage`, stats additionally carry "coverage"
+        (slots_hit / slots_total / fraction / by_band / curve — the
+        (completed, slots_hit) pair at every poll) and the result dict a
+        "coverage_map" bool array (the global OR of lane maps, the
+        artifact `hunt --coverage-out` persists).
         """
         import numpy as np
 
@@ -1330,6 +1425,10 @@ class Engine:
         infra: list = []
         abandoned: list = []
         stats = {"host_syncs": 0, "drains": 0, "dispatches": 0}
+        # (completed, slots_hit) at every blocking poll: the live
+        # coverage curve — its deltas are the "new slots this poll
+        # cycle" signal the plateau detector and StatsEmitter consume
+        cov_curve: list = []
 
         def drain(c: StreamCarry) -> StreamCarry:
             f_seeds, f_codes, f_n, a_seeds, a_n = jax.device_get(
@@ -1355,6 +1454,8 @@ class Engine:
                 raise RuntimeError(
                     "run_stream result ring overflowed (drain policy bug)"
                 )
+            if self.config.coverage:
+                cov_curve.append((int(counters[0]), int(counters[6])))
             return counters
 
         drain_mark = ring_capacity - batch
@@ -1409,7 +1510,25 @@ class Engine:
                     jax.device_get(carry.fr_metrics)
                 )
             }
-        return {
+        cov_stats = {}
+        cov_map_np = None
+        if self.config.coverage:
+            # one extra small transfer (2^14/32 words), after streaming
+            # is over: the global map itself, unpacked to the bool[S]
+            # form every host-side consumer reads
+            from ..runtime.coverage import coverage_dict, unpack_map
+
+            cov_map_np = unpack_map(
+                np.asarray(jax.device_get(carry.cov_map)),
+                self.config.cov_slots_log2,
+            )
+            cov_stats = {
+                "coverage": {
+                    **coverage_dict(cov_map_np, self.config.cov_slots_log2),
+                    "curve": cov_curve,
+                }
+            }
+        out = {
             "completed": int(counters[0]),
             "failing": failing,
             "infra": infra,
@@ -1423,8 +1542,12 @@ class Engine:
                 "donation": bool(donate),
                 "pipelined": bool(pipelined),
                 **fr_stats,
+                **cov_stats,
             },
         }
+        if cov_map_np is not None:
+            out["coverage_map"] = cov_map_np
+        return out
 
     def make_runner(self, max_steps: int = 10_000, mesh=None):
         """A jitted `seeds -> BatchResult`, optionally sharded over a mesh

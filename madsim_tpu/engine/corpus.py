@@ -39,6 +39,10 @@ def config_to_dict(cfg: EngineConfig) -> dict:
     # recorder itself at the recorded cadence
     for k in ("flight_recorder", "fr_digest_every", "fr_digest_ring"):
         d.pop(k, None)
+    # scenario coverage is the same class of gate: write-only telemetry,
+    # asserted bit-identical — entries must replay with or without it
+    for k in ("coverage", "cov_slots_log2"):
+        d.pop(k, None)
     return d
 
 

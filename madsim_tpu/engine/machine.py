@@ -203,3 +203,24 @@ class Machine:
     def summary(self, nodes: Any) -> Any:
         """Small pytree gathered back to host per lane."""
         return jnp.int32(0)
+
+    def coverage_projection(self, nodes: Any, now_us) -> jax.Array:
+        """Abstract-state word for the scenario-coverage map
+        (`EngineConfig.coverage`, ops/coverage.py): project the whole
+        node-state pytree down to a uint32 of coarse buckets — the
+        engine hashes it with the popped event kind and fault context
+        into the per-lane hit map every step.
+
+        Contract: pure function of (nodes, now_us); put the model's
+        coarsest "phase" notion (progress stage, term/txn/generation
+        bucket) in the LOW 3 BITS — those become the visible phase axis
+        of the (band, phase) cell report — and keep the whole word to a
+        handful of small bucketed fields. Too fine a projection (raw
+        counters, timestamps) saturates the map and destroys the
+        plateau signal; too coarse and saturation is declared early.
+
+        Default: constant 0. Coverage still distinguishes event kinds,
+        destination nodes and fault contexts, so the map works for any
+        machine — a model projection just makes it much sharper.
+        """
+        return jnp.uint32(0)

@@ -363,3 +363,21 @@ class EtcdMachine(Machine):
             "revision": nodes.srv_rev[SERVER],
             "writes_acked": jnp.sum(nodes.cl_writes),
         }
+
+    def coverage_projection(self, nodes: EtcdState, now_us):
+        """Scenario projection: election generation bucket (phase) x
+        ownership/lease occupancy x believed-leader count x write
+        progress — the lease-safety interleaving axes (handovers seen,
+        split brain pressure, workload depth)."""
+        gen_b = jnp.clip(nodes.srv_gen[SERVER], 0, 7)
+        owner_set = (nodes.srv_owner[SERVER] >= 0).astype(jnp.int32)
+        believers = jnp.clip(jnp.sum(nodes.cl_leader.astype(jnp.int32)), 0, 3)
+        leases = jnp.clip(jnp.sum(nodes.cl_has_lease.astype(jnp.int32)), 0, 3)
+        writes_b = jnp.clip(jnp.max(nodes.cl_writes), 0, 7)
+        return (
+            gen_b
+            | (owner_set << 3)
+            | (believers << 4)
+            | (leases << 6)
+            | (writes_b << 8)
+        ).astype(jnp.uint32)

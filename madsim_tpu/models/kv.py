@@ -189,3 +189,18 @@ class KvMachine(Machine):
             "server_version": nodes.version[SERVER],
             "total_acked": jnp.sum(nodes.acked_version),
         }
+
+    def coverage_projection(self, nodes: KvState, now_us):
+        """Scenario projection: server version bucket (phase) x worst
+        client staleness lag x in-flight request pressure — the
+        linearizability-relevant shape of a leased-KV interleaving."""
+        ver = jnp.clip(nodes.version[SERVER], 0, 7)
+        lag = jnp.clip(
+            nodes.version[SERVER] - jnp.min(nodes.acked_version[1:]), 0, 7
+        )
+        pending = jnp.clip(
+            jnp.sum((nodes.pending_kind[1:] != 0).astype(jnp.int32)), 0, 3
+        )
+        return (
+            ver | (lag << 3) | (pending << 6) | (jnp.any(nodes.stale).astype(jnp.int32) << 8)
+        ).astype(jnp.uint32)

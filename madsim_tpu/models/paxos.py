@@ -291,6 +291,25 @@ class PaxosMachine(Machine):
             "rounds": nodes.round[: self.NUM_PROPOSERS].max(),
         }
 
+    def coverage_projection(self, nodes: PaxosState, now_us):
+        """Scenario projection: highest ballot bucket (phase) x
+        proposer-phase spread x decisions landed x chosen-register state
+        — the duel-shape axes (which round, are proposers racing, is a
+        value locked in)."""
+        ballot_b = jnp.clip(jnp.max(nodes.ballot), 0, 7)
+        max_phase = jnp.clip(jnp.max(nodes.phase[: self.NUM_PROPOSERS]), 0, 3)
+        decided_n = jnp.clip(
+            jnp.sum(nodes.decided[: self.NUM_PROPOSERS].astype(jnp.int32)), 0, 3
+        )
+        promised_b = jnp.clip(jnp.max(nodes.promised) + 1, 0, 7)
+        return (
+            ballot_b
+            | (max_phase << 3)
+            | (decided_n << 5)
+            | (nodes.chosen_any[0].astype(jnp.int32) << 7)
+            | (promised_b << 8)
+        ).astype(jnp.uint32)
+
 
 class NoPromiseCheckPaxos(PaxosMachine):
     """Bug variant: acceptors accept any ACCEPT regardless of promised

@@ -465,3 +465,26 @@ class RaftMachine(Machine):
             "min_commit": jnp.min(nodes.commit),
             "num_leaders": jnp.sum((nodes.role == LEADER).astype(jnp.int32)),
         }
+
+    def coverage_projection(self, nodes: RaftState, now_us):
+        """Scenario projection (EngineConfig.coverage): term bucket
+        (phase, low 3 bits) x leader count x committed-log divergence x
+        cross-node term delta — the cluster-shape axes along which raft
+        interleavings actually differ (which election round, split
+        leadership, how far replicas disagree)."""
+        term_b = jnp.clip(jnp.max(nodes.term), 0, 7)  # phase bits
+        leaders = jnp.clip(
+            jnp.sum((nodes.role == LEADER).astype(jnp.int32)), 0, 3
+        )
+        commit_div = jnp.clip(jnp.max(nodes.commit) - jnp.min(nodes.commit), 0, 7)
+        term_delta = jnp.clip(jnp.max(nodes.term) - jnp.min(nodes.term), 0, 3)
+        candidates = jnp.clip(
+            jnp.sum((nodes.role == CANDIDATE).astype(jnp.int32)), 0, 3
+        )
+        return (
+            term_b
+            | (leaders << 3)
+            | (commit_div << 5)
+            | (term_delta << 8)
+            | (candidates << 10)
+        ).astype(jnp.uint32)

@@ -32,6 +32,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from flax import struct
+from jax import lax
 
 from ..engine.machine import (
     Machine,
@@ -265,3 +266,23 @@ class TwoPcMachine(Machine):
             "committed": jnp.sum(all_commit.astype(jnp.int32)),
             "aborted": jnp.sum(all_abort.astype(jnp.int32)),
         }
+
+    def coverage_projection(self, nodes: TwoPcState, now_us):
+        """Scenario projection: txn index (phase, low 3 bits) x votes
+        collected for the in-flight txn x abort pressure — the 2PC
+        decision-tree axes (how deep into the workload, how close to a
+        decision, has any txn gone the abort way)."""
+        phase = jnp.clip(nodes.cur_txn[COORD], 0, 7)
+        votes_in = jnp.clip(
+            lax.population_count(nodes.votes_recv[COORD]), 0, 7
+        )
+        part = nodes.outcome[1:, :]
+        aborted_txns = jnp.clip(
+            jnp.sum(jnp.any(part == ABORT, axis=0).astype(jnp.int32)), 0, 3
+        )
+        committed_txns = jnp.clip(
+            jnp.sum(jnp.any(part == COMMIT, axis=0).astype(jnp.int32)), 0, 7
+        )
+        return (
+            phase | (votes_in << 3) | (aborted_txns << 6) | (committed_txns << 8)
+        ).astype(jnp.uint32)

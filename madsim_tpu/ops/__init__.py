@@ -10,6 +10,10 @@ beats a data-dependent heap on TPU by a wide margin. Lexicographic
 Reference semantics being replicated: naive-timer pop-nearest
 (madsim/src/sim/time/mod.rs:45-59) with FIFO tie-break on insertion seq.
 
+Siblings: `step_rng.py` (the versioned per-step RNG word contract),
+`pallas_pop.py` (fused pop+gather kernel), `coverage.py` (the
+scenario-coverage fold the observability layer rides).
+
 Input domain: times and seqs must be < 2**31-1 (INT32_MAX doubles as the
 masking sentinel). The engine's int32 microsecond horizon and monotone
 next_seq counter guarantee both by construction.

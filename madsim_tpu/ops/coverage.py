@@ -1,0 +1,125 @@
+"""Scenario-coverage fold kernel — the AFL-style map the step kernel feeds.
+
+FoundationDB-style simulation shops treat *explored-state coverage* as
+the first-class signal for when a hunt is done (thousands more seeds
+finding new interleavings vs a hunt that saturated long ago); AFL keeps
+that signal cheap with a fixed-size hashed hit map on the hot path. This
+module is the device half of that layer for the TPU engine: every popped
+event hashes (abstract-state projection, event kind, fault context) into
+one slot of a per-lane uint8 saturating-count map, updated with a single
+gather + scatter per lane per step (NOT a one-hot masked select — a
+2^14-wide select per step would dwarf the step itself).
+
+Slot layout is structured, not a flat hash, so the map stays *decodable*
+on the host (runtime/coverage.py):
+
+    slot = [ band:3 | phase:3 | mix:(slots_log2-6) ]
+
+  * band (top 3 bits): the popped event's class — 0 timer, 1 message,
+    2..7 the fault KIND of a fault event (K_PAIR..K_DELAY). Per-band
+    slot counts are the "per-fault-kind marginal coverage" signal: which
+    chaos vocabulary is still finding new abstract states.
+  * phase (next 3 bits): the low 3 bits of the model's
+    `coverage_projection` word — each model puts its coarsest progress
+    notion there (raft: term bucket; 2pc: txn index; see the models).
+    (band, phase) pairs are the 64 "cells" the CLI report ranks.
+  * mix: an xor-multiply hash of the full projection word, the event
+    tuple discriminants and the fault-context word.
+
+Representation: one HIT BIT per slot, packed 32 to an int32 word (the
+"bit" option of AFL's bit/count family). Counts were measured and
+rejected: a `uint8[lanes, 2^14]` count map cost the flagship CPU bench
+~15% — the read-modify-write scatter forced XLA to materialize a copy
+of the 128 MiB operand every step — while the packed-word map (16x
+smaller, 2 KiB per lane) folds for free; the hit-SET, which is all the
+plateau/marginal/diff consumers read, is identical by construction.
+
+The map is monotone (bits only set), so partial maps are always subsets
+of final maps and OR-reducing lanes at *every* stream harvest is
+idempotent — the global vector needs no done-mask bookkeeping.
+
+Gate discipline matches the flight recorder: `EngineConfig.coverage`
+off means the lane carries `{}` and the step adds literally no ops
+(asserted bit-identical in tests/test_step_gates.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Default map size: 2^14 slots = 512 packed int32 words = 2 KiB per
+# lane. AFL's classic 64 KiB map tracks edge pairs of real binaries;
+# the engine's abstract scenario space is far smaller, and 2 KiB keeps
+# the [lanes, words] block at 16 MiB for the flagship 8192-lane batch.
+COV_SLOTS_LOG2_DEFAULT = 14
+COV_WORD_BITS = 32  # slots per packed map word
+
+# Band index space (top 3 bits of the slot): event class, with fault
+# events split per FaultPlan kind. Mirrored as literals in
+# runtime/coverage.py (the host decoder never imports jax).
+COV_BAND_BITS = 3
+COV_PHASE_BITS = 3
+COV_BANDS = 1 << COV_BAND_BITS
+COV_BAND_NAMES = ("timer", "msg", "pair", "kill", "dir", "group", "storm", "delay")
+
+# mix constants: murmur3 fmix / Weyl — odd multipliers, same family as
+# core.digest_fold (any single-bit input change avalanches)
+_MIX_SEED = 0x9E3779B9
+_MIX_M = 0x85EBCA6B
+
+
+def cov_mix(words) -> jax.Array:
+    """xor-multiply-xorshift fold of a list of traced scalars into one
+    uint32 hash word."""
+    h = jnp.uint32(_MIX_SEED)
+    for w in words:
+        w = jnp.asarray(w).astype(jnp.uint32)
+        h = (h ^ w) * jnp.uint32(_MIX_M)
+        h = h ^ (h >> 13)
+    return h
+
+
+def cov_slot(
+    abstract,
+    ev_kind,
+    ev_node,
+    op_word,
+    fault_ctx,
+    slots_log2: int,
+) -> jax.Array:
+    """Map one popped event to its slot index (int32 in [0, 2^slots_log2)).
+
+    `abstract` is the model's projection word (uint32), `op_word` the
+    event discriminant (payload[0] for msg/fault events, 0 for timers —
+    timer ids are epoch-encoded and would inflate slots per restart),
+    `fault_ctx` the packed fault-environment word built by the step
+    kernel (killed count | clog/storm/spike flags).
+    """
+    ev_kind = jnp.asarray(ev_kind).astype(jnp.int32)
+    # band: timer 0 / msg 1 / fault 2+kind (apply and undo share a kind).
+    # EV_FAULT mirrored as a literal (2): engine.core imports this module.
+    fault_kind = jnp.clip(jnp.asarray(op_word).astype(jnp.int32) // 2, 0, COV_BANDS - 3)
+    band = jnp.where(ev_kind == 2, 2 + fault_kind, jnp.clip(ev_kind, 0, 1))
+    abstract = jnp.asarray(abstract).astype(jnp.uint32)
+    phase = (abstract & jnp.uint32((1 << COV_PHASE_BITS) - 1)).astype(jnp.int32)
+    mix_bits = slots_log2 - COV_BAND_BITS - COV_PHASE_BITS
+    h = cov_mix([abstract, ev_kind, ev_node, op_word, fault_ctx])
+    mix = (h & jnp.uint32((1 << mix_bits) - 1)).astype(jnp.int32)
+    return (band << (slots_log2 - COV_BAND_BITS)) | (phase << mix_bits) | mix
+
+
+def cov_fold(cov_map: jax.Array, slot, hit) -> jax.Array:
+    """Set slot's hit bit when `hit` (traced bool); when not, the word
+    ORs in 0 — a deterministic no-op, so frozen lanes stay
+    bit-identical. One word gather + one word scatter per lane per
+    step, never a map-wide select."""
+    w = slot >> 5
+    bit = (jnp.int32(1) << (slot & 31)) * hit.astype(jnp.int32)
+    return cov_map.at[w].set(cov_map[w] | bit)
+
+
+def empty_cov_map(slots_log2: int) -> jax.Array:
+    """Zeroed per-lane hit map: int32[(2^slots_log2)/32] packed words
+    (slot s lives in word s >> 5, bit s & 31)."""
+    return jnp.zeros(((1 << slots_log2) // COV_WORD_BITS,), jnp.int32)

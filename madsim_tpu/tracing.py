@@ -91,6 +91,91 @@ def init_tracing(level: str = "INFO", jsonl_path: Optional[str] = None) -> None:
         root.addHandler(jh)
 
 
+class StatsEmitter:
+    """Time-series run telemetry for long hunts/benches — observable
+    from OUTSIDE the process, which a log stream is not:
+
+      * `<base>.jsonl` — one JSON object per emitted record (append;
+        the whole history, replotting-friendly);
+      * `<base>.prom` — a Prometheus textfile-collector snapshot of the
+        LATEST record's numeric leaves (node_exporter's textfile
+        directory, or curl via `serve --service stats` /metrics);
+      * `<base>.json` — the latest record verbatim (the `/stats`
+        endpoint's payload; dashboards read one file, not a log).
+
+    Snapshots are written atomically (tmp + rename) so a scraper never
+    reads a torn file. Records are plain dicts; nested dicts flatten to
+    `a_b_c` gauge names, non-numeric leaves are JSONL-only. Emission
+    must never take down a hunt: I/O errors are swallowed after the
+    constructor proves the base path writable."""
+
+    def __init__(self, base: str, prefix: str = "madsim_tpu"):
+        self.base = base
+        self.prefix = prefix
+        self.seq = 0
+        self._jsonl = open(base + ".jsonl", "a")
+
+    @property
+    def jsonl_path(self) -> str:
+        return self.base + ".jsonl"
+
+    @property
+    def prom_path(self) -> str:
+        return self.base + ".prom"
+
+    @property
+    def snapshot_path(self) -> str:
+        return self.base + ".json"
+
+    @staticmethod
+    def _flatten(record: dict, prefix: str = "") -> dict:
+        out: dict = {}
+        for k, v in record.items():
+            key = f"{prefix}_{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                out.update(StatsEmitter._flatten(v, key))
+            elif isinstance(v, bool):
+                out[key] = int(v)
+            elif isinstance(v, (int, float)):
+                out[key] = v
+        return out
+
+    def _atomic_write(self, path: str, text: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        import os
+
+        os.replace(tmp, path)
+
+    def emit(self, record: dict) -> dict:
+        """Emit one record (a plain dict of stats). Returns the record
+        as written (with `ts`/`seq` stamped)."""
+        self.seq += 1
+        row = {"ts": round(time.time(), 6), "seq": self.seq, **record}
+        try:
+            self._jsonl.write(json.dumps(row, sort_keys=True) + "\n")
+            self._jsonl.flush()
+            lines = [f"# emitted by madsim_tpu StatsEmitter (seq {self.seq})"]
+            for k, v in sorted(self._flatten(row).items()):
+                name = f"{self.prefix}_{k}".replace("-", "_").replace(".", "_")
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {v}")
+            self._atomic_write(self.prom_path, "\n".join(lines) + "\n")
+            self._atomic_write(
+                self.snapshot_path, json.dumps(row, sort_keys=True) + "\n"
+            )
+        except OSError:  # telemetry must never kill the run
+            pass
+        return row
+
+    def close(self) -> None:
+        try:
+            self._jsonl.close()
+        except OSError:
+            pass
+
+
 def instrument(fn: Callable[..., Any] = None, *, name: str = "", level: int = logging.DEBUG):
     """Span-style decorator: logs entry/exit of a sync or async fn with
     the sim context (reference: `#[instrument]` on net ops). An

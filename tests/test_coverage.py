@@ -1,0 +1,225 @@
+"""Scenario-coverage telemetry (PR-4 observability): the coverage map's
+contract has four legs, each tested here:
+
+  1. the map is a pure function of the execution — golden slot
+     constants for one pinned seed batch (same discipline as
+     test_golden_streams.py / the digest trails: a change here means the
+     slot construction or the underlying stream moved, and must ship as
+     a new layout version);
+  2. the banded [band|phase|mix] layout decodes: fault bands populate
+     exactly when their kinds are enabled, marginals sum to the total;
+  3. the stream harvest's OR-reduced global vector equals the OR of the
+     per-lane batch maps over the same seeds (cross-executor identity);
+  4. the host layer — PlateauDetector policy, coverage-doc
+     save/load/diff round-trip, the `coverage` CLI report, and the
+     `--stop-on-plateau` early exit end to end.
+
+(The gate-off bit-identity leg lives in test_step_gates.py with the
+other step-path gates.)
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
+from madsim_tpu.models.raft import RaftMachine
+from madsim_tpu.runtime.coverage import (
+    COV_BAND_NAMES,
+    PlateauDetector,
+    coverage_dict,
+    decode_map,
+    diff_maps,
+    doc_maps,
+    encode_map,
+    load_coverage_doc,
+    make_coverage_doc,
+    render_report,
+    save_coverage_doc,
+    top_uncovered,
+    unpack_map,
+)
+
+# Small slot budget (2^10) keeps the golden constants one screen; the
+# layout maths are identical at the 2^14 default.
+BASE = EngineConfig(
+    horizon_us=2_000_000,
+    queue_capacity=32,
+    faults=FaultPlan(
+        n_faults=2, t_max_us=1_500_000, dur_min_us=100_000, dur_max_us=600_000
+    ),
+    coverage=True,
+    cov_slots_log2=10,
+)
+
+# Golden coverage for RaftMachine(5, 8) under BASE, seeds 0..5,
+# max_steps=300 — captured at introduction (PR-4) under the pinned
+# partitionable threefry lowering, frozen from birth.
+GOLDEN_SLOTS_HIT = 40
+# sorted slot indices of the lane-OR map: note the banded structure —
+# [16, 31] is the timer band's phase-1 cell (all 16 mix slots of the
+# 2^10 test layout), [144, 159] the msg band's phase-1 cell, 285 a
+# pair-band slot, 405/411/414 kill-band slots
+GOLDEN_OR_SLOTS = [
+    2, 9, 12, 13, 16, 17, 18, 19, 20, 21, 22, 23, 24, 25, 26, 27, 28, 29,
+    30, 31, 144, 145, 146, 147, 148, 149, 150, 151, 152, 153, 154, 155,
+    156, 157, 158, 159, 285, 405, 411, 414,
+]
+GOLDEN_PER_LANE = [39, 25, 24, 26, 23, 33]  # per-lane nonzero-slot counts
+
+
+def _machine():
+    return RaftMachine(num_nodes=5, log_capacity=8)
+
+
+@pytest.fixture(scope="module")
+def base_run():
+    eng = Engine(_machine(), BASE)
+    res = jax.jit(lambda s: eng.run_batch(s, 300))(jnp.arange(6, dtype=jnp.uint32))
+    return eng, res
+
+
+def test_golden_coverage_map_pinned(base_run):
+    _eng, res = base_run
+    maps = unpack_map(np.asarray(res.cov["map"]), BASE.cov_slots_log2)
+    or_slots = sorted(np.flatnonzero(maps.any(axis=0)).tolist())
+    assert maps.sum(axis=1).tolist() == GOLDEN_PER_LANE
+    assert or_slots == GOLDEN_OR_SLOTS
+    assert len(or_slots) == GOLDEN_SLOTS_HIT
+
+
+def test_band_marginals_decode(base_run):
+    """by_band marginals sum to the total; only the enabled fault kinds'
+    bands (pair/kill under BASE) can populate; cell table is consistent."""
+    eng, res = base_run
+    m = unpack_map(np.asarray(res.cov["map"]), BASE.cov_slots_log2).any(axis=0)
+    d = coverage_dict(m, BASE.cov_slots_log2)
+    assert d["slots_hit"] == int(m.sum()) > 0
+    assert sum(d["by_band"].values()) == d["slots_hit"]
+    assert d["by_band"]["timer"] > 0 and d["by_band"]["msg"] > 0
+    for never_enabled in ("dir", "group", "storm", "delay"):
+        assert d["by_band"][never_enabled] == 0
+    cells = top_uncovered(m, BASE.cov_slots_log2, top=64)
+    assert len(cells) == 64
+    assert sum(c["hit"] for c in cells) == d["slots_hit"]
+
+
+def test_stream_harvest_equals_batch_or(base_run):
+    """The stream's global OR vector over the same seeds equals the OR
+    of the batch run's per-lane maps — the cross-executor identity the
+    plateau signal rests on. segment_steps == max_steps so both paths
+    cap every lane at exactly 300 events."""
+    eng, res = base_run
+    out = eng.run_stream(6, batch=6, segment_steps=300, max_steps=300)
+    batch_or = unpack_map(np.asarray(res.cov["map"]), BASE.cov_slots_log2).any(axis=0)
+    assert bool((np.asarray(out["coverage_map"]) == batch_or).all())
+    cov = out["stats"]["coverage"]
+    assert cov["slots_hit"] == int(batch_or.sum())
+    # the curve's final point agrees with the final summary
+    assert cov["curve"][-1][1] == cov["slots_hit"]
+    assert cov["fraction"] == round(cov["slots_hit"] / (1 << 10), 6)
+
+
+def test_plateau_detector_policy():
+    with pytest.raises(ValueError):
+        PlateauDetector(0)
+    d = PlateauDetector(2)
+    assert not d.update(10)  # first batch: 10 new slots
+    assert not d.update(10)  # zero new: streak 1
+    assert d.update(10)  # zero new: streak 2 -> plateau
+    assert d.plateaued and d.batches == 3
+    # growth resets the streak
+    d = PlateauDetector(2)
+    assert not d.update(10)
+    assert not d.update(10)
+    assert not d.update(11)  # new slot: streak back to 0
+    assert not d.update(11)
+    assert d.update(11)
+    # a non-monotone feed (per-chunk map smaller than cumulative best)
+    # never counts as growth
+    d = PlateauDetector(1)
+    assert not d.update(5)
+    assert d.update(3)
+
+
+def test_coverage_doc_roundtrip_and_diff(tmp_path):
+    rng = np.random.default_rng(7)
+    a = rng.random(1 << 10) < 0.1
+    b = a.copy()
+    b[:32] = True  # run B reaches 32 extra early slots
+    assert bool((decode_map(encode_map(a), 10) == a).all())
+    path_a, path_b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    save_coverage_doc(path_a, make_coverage_doc({"raft": a}, 10, meta={"seeds": 4}))
+    save_coverage_doc(path_b, make_coverage_doc({"raft": b}, 10))
+    doc_a, doc_b = load_coverage_doc(path_a), load_coverage_doc(path_b)
+    assert doc_a["meta"]["seeds"] == 4
+    assert bool((doc_maps(doc_a)["raft"] == a).all())
+    d = diff_maps(doc_maps(doc_a)["raft"], doc_maps(doc_b)["raft"])
+    assert d["only_a"] == 0 and d["both"] == int(a.sum())
+    assert d["only_b"] == int(b.sum()) - int(a.sum())
+    report = render_report(doc_b, top=4, diff_doc=doc_a)
+    assert "raft:" in report and f"+{d['only_b']} new slots" in report
+    # version skew is rejected, not silently misdecoded
+    doc = json.load(open(path_a))
+    doc["version"] = 99
+    json.dump(doc, open(path_a, "w"))
+    with pytest.raises(ValueError, match="version"):
+        load_coverage_doc(path_a)
+
+
+def test_cli_coverage_report(tmp_path, capsys):
+    from madsim_tpu.__main__ import main
+
+    rng = np.random.default_rng(3)
+    m = rng.random(1 << 10) < 0.05
+    path = str(tmp_path / "cov.json")
+    save_coverage_doc(path, make_coverage_doc({"etcd": m}, 10))
+    assert main(["coverage", path, "--top", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "etcd:" in out and "thinnest band x phase cells" in out
+    for name in COV_BAND_NAMES[:2]:
+        assert name in out
+
+
+def test_stop_on_plateau_cli_end_to_end(tmp_path, capsys):
+    """A fault-free echo config saturates its scenario space almost
+    immediately: `explore --stream --coverage --stop-on-plateau` must
+    exit early, say so honestly, and the StatsEmitter JSONL stream must
+    parse and agree with the final report."""
+    from madsim_tpu.__main__ import main
+
+    base = str(tmp_path / "stats")
+    rc = main([
+        "explore", "--machine", "echo", "--seeds", "160", "--batch", "32",
+        "--stream", "--coverage", "--faults", "0", "--horizon", "1.0",
+        "--max-steps", "400", "--stop-on-plateau", "2", "--stats", base,
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "coverage plateau" in out or "plateau" in out
+    assert "coverage:" in out
+    rows = [json.loads(l) for l in open(base + ".jsonl")]
+    batches = [r for r in rows if r["kind"] == "explore_batch"]
+    [summary] = [r for r in rows if r["kind"] == "explore_summary"]
+    assert summary["plateau"] is True
+    assert summary["batches_run"] == len(batches) < summary["batches_planned"] + 1
+    # the emitted coverage total matches the rendered report line
+    slots = summary["coverage"]["slots_hit"]
+    assert f"coverage: {slots}/" in out
+    # cumulative completed in the summary equals the printed stream total
+    assert f"streamed {summary['completed']} seeds" in out
+
+
+def test_plateau_requires_coverage_gate(tmp_path):
+    from madsim_tpu.__main__ import main
+
+    with pytest.raises(SystemExit, match="--coverage"):
+        main([
+            "explore", "--machine", "echo", "--seeds", "32", "--batch", "32",
+            "--stream", "--faults", "0", "--stop-on-plateau", "2",
+            "--max-steps", "200",
+        ])

@@ -93,6 +93,34 @@ def test_flight_recorder_gate_off_bit_identical(cfg, rng_stream):
     assert r_off.fr == {} and r_on.fr  # recorder state only when gated on
 
 
+def test_coverage_gate_off_bit_identical():
+    """The PR-4 scenario-coverage gate (projection hash + per-lane map
+    scatter in the step) must leave every simulation result bit-exactly
+    unchanged — coverage ON vs OFF under the full chaos vocabulary. The
+    map consumes no RNG words (stream-version independence is by
+    construction; tests/test_coverage.py exercises the v2 default) and
+    writes only its own state; gate-off carries cov == {} (literally no
+    added ops). One config pair, not a matrix: tier-1 compile budget."""
+    cfg = dataclasses.replace(FULL_CHAOS, rng_stream=3)
+    r_off = _run(Engine(_machine(), cfg))
+    r_on = _run(
+        Engine(
+            _machine(),
+            dataclasses.replace(cfg, coverage=True, cov_slots_log2=12),
+        )
+    )
+    _assert_results_equal(r_off, r_on)
+    assert r_off.cov == {} and r_on.cov  # map state only when gated on
+
+
+def test_coverage_rejects_bad_slot_budget():
+    with pytest.raises(ValueError, match="cov_slots_log2"):
+        Engine(
+            _machine(),
+            dataclasses.replace(BENCH_LIKE, coverage=True, cov_slots_log2=5),
+        )
+
+
 def test_rng_v3_stream_executor_and_replay_agree():
     """v3 results are executor-independent (batch vs stream) and the
     host replay reproduces a v3 device finding bit-identically — the

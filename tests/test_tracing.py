@@ -112,3 +112,46 @@ def test_cli_log_level_wiring(tmp_path, capsys):
         root.handlers[:] = before
     assert rc == 0
     assert any(json.loads(l)["msg"] == "wired" for l in open(path))
+
+
+def test_stats_emitter_jsonl_roundtrip(tmp_path):
+    """StatsEmitter (PR-4): every emitted record lands in BASE.jsonl and
+    round-trips exactly (modulo the stamped ts/seq); the BASE.json
+    snapshot always holds the LAST record; the BASE.prom textfile holds
+    every numeric leaf (nested dicts flattened) as a gauge."""
+    from madsim_tpu.tracing import StatsEmitter
+
+    base = str(tmp_path / "run")
+    em = StatsEmitter(base)
+    recs = [
+        {"kind": "hunt_batch", "batch": 1, "seeds_per_sec": 512.5,
+         "coverage": {"slots_hit": 10, "new_slots": 10}, "note": "warm"},
+        {"kind": "hunt_batch", "batch": 2, "seeds_per_sec": 640.0,
+         "coverage": {"slots_hit": 12, "new_slots": 2}, "plateau": False},
+    ]
+    for r in recs:
+        em.emit(r)
+    em.close()
+
+    lines = [json.loads(l) for l in open(base + ".jsonl")]
+    assert len(lines) == len(recs)
+    for row, rec in zip(lines, recs):
+        assert {k: row[k] for k in rec} == rec  # payload round-trips
+        assert row["seq"] >= 1 and row["ts"] > 0
+    assert [l["seq"] for l in lines] == [1, 2]
+
+    snap = json.loads(open(base + ".json").read())
+    assert {k: snap[k] for k in recs[-1]} == recs[-1]
+
+    prom = open(base + ".prom").read()
+    assert "madsim_tpu_coverage_slots_hit 12" in prom
+    assert "madsim_tpu_seeds_per_sec 640.0" in prom
+    assert "madsim_tpu_plateau 0" in prom  # bools emit as 0/1 gauges
+    assert "note" not in prom  # strings are JSONL-only
+    # append mode: a reopened emitter extends history, replaces snapshots
+    em2 = StatsEmitter(base)
+    em2.emit({"kind": "summary", "completed": 128})
+    em2.close()
+    lines = [json.loads(l) for l in open(base + ".jsonl")]
+    assert len(lines) == 3 and lines[-1]["kind"] == "summary"
+    assert json.loads(open(base + ".json").read())["completed"] == 128
