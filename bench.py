@@ -153,7 +153,7 @@ def main() -> None:
     # Scenario coverage (PR-4 observability gate): default ON for the
     # same reason — the flagship number is captured with the full
     # observability stack riding the step (budget: recorder+coverage ON
-    # within 5% of the r07 capture; the vs_r07 field below is the
+    # within 5% of the r08 capture; the vs_r08 field below is the
     # receipt). =0 for an A/B.
     coverage = os.environ.get("MADSIM_TPU_COVERAGE", "1") not in ("", "0")
     cfg = EngineConfig(
@@ -246,30 +246,32 @@ def main() -> None:
                 Engine(eng.machine, dataclasses.replace(cfg, coverage=False))
             )
 
-    # 5%-budget receipt vs the r07 flagship capture (recorder ON,
-    # coverage predates). Only comparable when the run SHAPE matches the
+    # 5%-budget receipt vs the r08 flagship capture (recorder + coverage
+    # ON — the PR-4 observability-era baseline; the PR-5 chaos kinds are
+    # statically gated off in this config, so the compiled step is the
+    # same work). Only comparable when the run SHAPE matches the
     # recorded one (same lanes, same platform) — CI's tiny 512-lane
     # capture must not false-alarm. MADSIM_TPU_BENCH_ENFORCE_BUDGET=1
     # turns a violation into a nonzero exit for gating jobs.
     budget = None
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_r07.json")) as f:
-            r07 = json.load(f)
+                               "BENCH_r08.json")) as f:
+            r08 = json.load(f)
         if (
-            r07["diagnostics"]["lanes"] == lanes
-            and r07["platform"] == jax.devices()[0].platform
+            r08["diagnostics"]["lanes"] == lanes
+            and r08["platform"] == jax.devices()[0].platform
         ):
-            ratio = seeds_per_sec / r07["value"]
+            ratio = seeds_per_sec / r08["value"]
             budget = {
-                "vs_r07": round(ratio, 3),
+                "vs_r08": round(ratio, 3),
                 "within_5pct": ratio >= 0.95,
             }
             if not budget["within_5pct"]:
                 print(
                     f"bench: BUDGET VIOLATION — {seeds_per_sec:.1f} seeds/s "
-                    f"is {100 * (1 - ratio):.1f}% below the r07 capture "
-                    f"({r07['value']}) with the observability gates on",
+                    f"is {100 * (1 - ratio):.1f}% below the r08 capture "
+                    f"({r08['value']}) with the observability gates on",
                     file=sys.stderr, flush=True,
                 )
     except (OSError, KeyError, ValueError):

@@ -51,6 +51,13 @@ def build_machine(name: str, nodes: int = 0):
     class QuorumOffByOneRaft(RaftMachine):
         QUORUM_OFF_BY_ONE = True  # commit below majority (needs group faults)
 
+    class VolatileCommitRaft(RaftMachine):
+        PERSIST_COMMIT_NOT_LOG = True  # durable commitIndex, volatile log
+        #                                (caught only by --strict-restart)
+
+    class DupVoteRaft(RaftMachine):
+        DUP_VOTE_COUNT = True  # per-message vote tally (caught by dup chaos)
+
     class NoDedupMvcc(EtcdMvccMachine):
         NO_DEDUP = True  # retransmits double-apply (needs storms/dir clogs)
 
@@ -98,6 +105,12 @@ def build_machine(name: str, nodes: int = 0):
         "demo-quorumoffbyone-raft": lambda: QuorumOffByOneRaft(
             num_nodes=nodes or 5, log_capacity=8
         ),
+        "demo-volatilecommit-raft": lambda: VolatileCommitRaft(
+            num_nodes=nodes or 5, log_capacity=8
+        ),
+        "demo-dupvote-raft": lambda: DupVoteRaft(
+            num_nodes=nodes or 5, log_capacity=8
+        ),
         "demo-nodedup-mvcc": lambda: NoDedupMvcc(num_nodes=nodes or 4),
         "demo-giveup-mvcc": lambda: PrematureGiveupMvcc(num_nodes=nodes or 4),
         "demo-nopromise-multipaxos": lambda: NoPromiseCheckMultiPaxos(
@@ -138,6 +151,7 @@ def _build_engine(args):
             t_max_us=args.fault_tmax or int(args.horizon * 0.6e6) or 1,
             dur_min_us=100_000,
             dur_max_us=800_000,
+            strict_restart=bool(getattr(args, "strict_restart", False)),
             **_fault_kind_flags(args),
         ),
     )
@@ -149,9 +163,18 @@ def _fault_kind_flags(args) -> dict:
     # argsets may lack the flag; absent == legacy pair,kill
     raw = getattr(args, "fault_kinds", "pair,kill")
     kinds = {k.strip() for k in raw.split(",") if k.strip()}
-    known = {"pair", "kill", "dir", "group", "storm", "delay"}
+    known = {
+        "pair", "kill", "dir", "group", "storm", "delay",
+        "pause", "skew", "dup",
+    }
     if not kinds <= known:
         sys.exit(f"unknown fault kinds {sorted(kinds - known)}; choose from {sorted(known)}")
+    if kinds == {"dup"} and args.faults > 0:
+        sys.exit(
+            "dup is per-delivery chaos, not a scheduled fault: with "
+            "--faults > 0 pick at least one scheduled kind too "
+            "(e.g. --fault-kinds pair,kill,dup), or pass --faults 0"
+        )
     return {
         "allow_partition": "pair" in kinds,
         "allow_kill": "kill" in kinds,
@@ -159,7 +182,24 @@ def _fault_kind_flags(args) -> dict:
         "allow_group": "group" in kinds,
         "allow_storm": "storm" in kinds,
         "allow_delay": "delay" in kinds,
+        "allow_pause": "pause" in kinds,
+        "allow_skew": "skew" in kinds,
+        "allow_dup": "dup" in kinds,
     }
+
+
+def fault_kinds_str(fp) -> str:
+    """The --fault-kinds value that reproduces a FaultPlan's vocabulary
+    (the inverse of _fault_kind_flags; shrink prints it after kind
+    ablation so the repro line matches the MINIMIZED plan)."""
+    pairs = (
+        ("pair", fp.allow_partition), ("kill", fp.allow_kill),
+        ("dir", fp.allow_dir_clog), ("group", fp.allow_group),
+        ("storm", fp.allow_storm), ("delay", fp.allow_delay),
+        ("pause", fp.allow_pause), ("skew", fp.allow_skew),
+        ("dup", fp.allow_dup),
+    )
+    return ",".join(name for name, on in pairs if on) or "pair"
 
 
 def _repro_line(args, seed) -> str:
@@ -174,7 +214,8 @@ def _repro_line(args, seed) -> str:
         f"--fault-tmax {tmax} "
         f"--fault-kinds {getattr(args, 'fault_kinds', 'pair,kill')} "
         f"--rng-stream {getattr(args, 'rng_stream', 2)} "
-        f"--max-steps {args.max_steps}"
+        + ("--strict-restart " if getattr(args, "strict_restart", False) else "")
+        + f"--max-steps {args.max_steps}"
     )
 
 
@@ -196,8 +237,15 @@ def _print_fr_stats(stats) -> None:
     if not fr:
         return
     inj = ", ".join(f"{k}={v}" for k, v in fr["faults_injected"].items() if v)
+    extra = "".join(
+        f", {label} {fr[key]}"
+        for key, label in (
+            ("dup_injected", "dups"), ("amnesia_restarts", "amnesia restarts"),
+        )
+        if fr.get(key)
+    )
     print(
-        f"flight recorder: faults injected [{inj or 'none'}], "
+        f"flight recorder: faults injected [{inj or 'none'}]{extra}, "
         f"queue hwm {fr['queue_hwm']}, clogged-links hwm {fr['clog_links_hwm']}, "
         f"killed hwm {fr['killed_hwm']}"
     )
@@ -259,8 +307,6 @@ def _stream_batches(eng, args, purpose="explore"):
     sk = _stream_kwargs(args)
     batch = min(args.seeds, args.batch)
     planned = -(-args.seeds // batch)  # ceil
-    # compile + warm outside the timed loop (same discipline as before)
-    eng.run_stream(1, batch=batch, segment_steps=384, max_steps=args.max_steps, **sk)
 
     agg = {
         "completed": 0,
@@ -273,11 +319,95 @@ def _stream_batches(eng, args, purpose="explore"):
     cov_map = None
     cursor = args.seed
     plateaued = False
+    start_bi = 0
+
+    # --checkpoint PATH: restore per-batch progress recorded by an
+    # interrupted run (atomic JSON, runtime/checkpoint.py). Batch i
+    # always consumes the same seed range, so cursor + aggregates are
+    # the whole resumable state — the finished report is identical to
+    # the uninterrupted run's.
+    ckpt_path = getattr(args, "checkpoint", None)
+    stop_after = int(getattr(args, "stop_after_batches", 0) or 0)
+    if ckpt_path:
+        from .runtime.checkpoint import check_fingerprint, load_checkpoint
+
+        ck = load_checkpoint(ckpt_path)
+        if ck is not None:
+            err = check_fingerprint(ck, args)
+            if err:
+                sys.exit(f"--checkpoint {ckpt_path}: {err}")
+            agg["completed"] = int(ck["completed"])
+            agg["seeds_consumed"] = int(ck["seeds_consumed"])
+            agg["failing"] = [tuple(x) for x in ck["failing"]]
+            agg["infra"] = [tuple(x) for x in ck["infra"]]
+            agg["abandoned"] = list(ck["abandoned"])
+            cursor = int(ck["cursor"])
+            start_bi = int(ck["batch"])
+            plateaued = bool(ck.get("plateau", False))
+            if ck.get("cov_b64"):
+                from .runtime.coverage import decode_map
+
+                cov_map = decode_map(ck["cov_b64"], eng.config.cov_slots_log2)
+            if detector is not None and ck.get("detector"):
+                d = ck["detector"]
+                detector.best = int(d["best"])
+                detector.streak = int(d["streak"])
+                detector.batches = int(d["batches"])
+            if ck.get("done"):
+                print(
+                    f"checkpoint {ckpt_path}: run already complete "
+                    f"({start_bi}/{planned} batches, "
+                    f"{agg['completed']} seeds) — nothing to resume"
+                )
+            else:
+                print(f"resumed at batch {start_bi + 1}/{planned} "
+                      f"({agg['completed']} seeds already completed)")
+                log.info(
+                    "checkpoint %s: resumed at batch %d/%d",
+                    ckpt_path, start_bi + 1, planned,
+                )
+
+    def _save_ckpt(bi_done: int, done_flag: bool) -> None:
+        if not ckpt_path:
+            return
+        from .runtime.checkpoint import fingerprint_from_args, save_checkpoint
+        from .runtime.coverage import encode_map
+
+        save_checkpoint(
+            ckpt_path,
+            {
+                "fingerprint": fingerprint_from_args(args),
+                "batch": bi_done,
+                "planned": planned,
+                "cursor": cursor,
+                "completed": agg["completed"],
+                "seeds_consumed": agg["seeds_consumed"],
+                "failing": [list(x) for x in agg["failing"]],
+                "infra": [list(x) for x in agg["infra"]],
+                "abandoned": list(agg["abandoned"]),
+                "cov_b64": encode_map(cov_map) if cov_map is not None else None,
+                "detector": (
+                    {
+                        "best": detector.best,
+                        "streak": detector.streak,
+                        "batches": detector.batches,
+                    }
+                    if detector is not None else None
+                ),
+                "plateau": plateaued,
+                "done": done_flag,
+            },
+        )
+
+    # compile + warm outside the timed loop (same discipline as before)
+    eng.run_stream(1, batch=batch, segment_steps=384, max_steps=args.max_steps, **sk)
+
     t_start = wall.perf_counter()
-    bi = -1
-    for bi in range(planned):
+    bi = start_bi - 1
+    for bi in range(start_bi, planned):
         chunk = min(batch, args.seeds - agg["completed"])
         if chunk <= 0:
+            _save_ckpt(bi, True)  # seed budget already consumed: complete
             break
         t0 = wall.perf_counter()
         out = eng.run_stream(
@@ -333,12 +463,25 @@ def _stream_batches(eng, args, purpose="explore"):
             emitter.emit(rec)
         if detector is not None and detector.update(slots_hit):
             plateaued = True
+        _save_ckpt(bi + 1, plateaued)
+        if plateaued:
             log.info(
                 "coverage plateau: no new slots for %d consecutive "
                 "batches — stopping after batch %d/%d",
                 plateau_n, bi + 1, planned,
             )
             break
+        if stop_after and bi + 1 >= stop_after:
+            # deliberate early stop (CI checkpoint smoke / operational
+            # "hunt in slices"): the checkpoint above has done=False,
+            # so the next --checkpoint run resumes at batch bi+2
+            log.info(
+                "stopping after batch %d/%d (--stop-after-batches %d; "
+                "resumable via --checkpoint)", bi + 1, planned, stop_after,
+            )
+            break
+    else:
+        _save_ckpt(planned, True)
 
     agg["elapsed_s"] = wall.perf_counter() - t_start
     agg["batches_run"] = bi + 1
@@ -350,7 +493,10 @@ def _stream_batches(eng, args, purpose="explore"):
 
         agg["stats"] = dict(agg["stats"])
         agg["stats"]["coverage"] = {
-            **coverage_dict(cov_map, eng.config.cov_slots_log2),
+            **coverage_dict(
+                cov_map, eng.config.cov_slots_log2,
+                band_bits=eng.cov_band_bits,
+            ),
             "plateau": plateaued,
             "plateau_patience": plateau_n,
         }
@@ -392,6 +538,7 @@ def _write_coverage_out(eng, args, agg) -> None:
     doc = make_coverage_doc(
         {args.machine: agg["coverage_map"]},
         eng.config.cov_slots_log2,
+        band_bits=eng.cov_band_bits,
         meta={
             "seeds": args.seeds,
             "seed_start": args.seed,
@@ -524,7 +671,9 @@ def cmd_explore(args) -> int:
             eng.config.cov_slots_log2,
         )
         _print_cov_stats(
-            {"coverage": coverage_dict(m, eng.config.cov_slots_log2)}
+            {"coverage": coverage_dict(
+                m, eng.config.cov_slots_log2, band_bits=eng.cov_band_bits
+            )}
         )
     if failing:
         codes = sorted({int(c) for c in res.fail_code.tolist() if c != 0})
@@ -788,8 +937,10 @@ def cmd_shrink(args) -> int:
         f"--horizon {sr.shrunk.horizon_us / 1e6} --queue {sr.shrunk.queue_capacity} "
         f"--faults {f.n_faults} --fault-tmax {f.t_max_us} "
         f"--loss {sr.shrunk.packet_loss_rate} --max-steps {sr.steps} "
-        f"--fault-kinds {getattr(args, 'fault_kinds', 'pair,kill')} "
-        f"--rng-stream {sr.shrunk.rng_stream}"
+        # kinds from the SHRUNK plan — ablation may have dropped some
+        f"--fault-kinds {fault_kinds_str(f)} "
+        + ("--strict-restart " if f.strict_restart else "")
+        + f"--rng-stream {sr.shrunk.rng_stream}"
     )
     return 0
 
@@ -1076,8 +1227,17 @@ def main(argv=None) -> int:
         p.add_argument(
             "--fault-kinds", default="pair,kill",
             help="comma list of fault kinds to draw from: "
-            "pair,kill,dir,group,storm,delay (default pair,kill; any "
-            "other kind switches to the v2 schedule derivation)",
+            "pair,kill,dir,group,storm,delay,pause,skew,dup (default "
+            "pair,kill; any other kind switches to the v2 schedule "
+            "derivation; dup is per-delivery Bernoulli duplication, not "
+            "a scheduled window)",
+        )
+        p.add_argument(
+            "--strict-restart", action="store_true",
+            help="crash-with-amnesia restarts: a restarted node keeps "
+            "ONLY the leaves its Machine.durable_spec() contract marks "
+            "durable — the engine wipes the rest generically, so "
+            "illegally-kept volatile state becomes findable",
         )
         p.add_argument(
             "--rng-stream", type=int, default=2, choices=(2, 3),
@@ -1141,6 +1301,12 @@ def main(argv=None) -> int:
             "signal — more seeds are no longer finding new scenarios); "
             "reported honestly in the summary",
         )
+        p.add_argument(
+            "--stop-after-batches", type=int, default=0, metavar="N",
+            help="deliberately stop after N seed batches (the run stays "
+            "resumable via --checkpoint; CI's interrupt/resume smoke and "
+            "'hunt in slices' both use this)",
+        )
 
     p = sub.add_parser("explore", help="run a seed batch, report failing seeds")
     common(p)
@@ -1202,6 +1368,13 @@ def main(argv=None) -> int:
     stream_flags(p)
     p.add_argument("--corpus", default="corpus.json")
     p.add_argument("--limit", type=int, default=5, help="max seeds to shrink+record")
+    p.add_argument(
+        "--checkpoint", default=None, metavar="PATH",
+        help="with --stream: persist per-batch progress (seed cursor, "
+        "failures, coverage map, plateau state) to PATH after every "
+        "batch; an interrupted hunt re-run with the same arguments "
+        "resumes exactly where it stopped ('resumed at batch k/n')",
+    )
     p.add_argument(
         "--coverage-out", default=None, metavar="PATH",
         help="with --coverage --stream: persist the hunt's cumulative "

@@ -11,7 +11,11 @@ See `core.py` for the architecture. Public surface:
     (`dispatch_depth`); `pipelined=False` keeps the r5 per-segment
     driver for one release (bit-identical results either way)
   * `replay(engine, seed)` — bit-identical single-seed CPU replay
-  * `FaultPlan` — randomized partition / kill-restart schedules
+  * `FaultPlan` — randomized chaos schedules: pair/dir/group
+    partitions, kill/restart, loss storms, delay spikes, pause/resume
+    windows (freeze + deferred delivery), per-node clock-skew windows,
+    Bernoulli message duplication (`allow_dup`), and crash-with-amnesia
+    restarts (`strict_restart` + `Machine.durable_spec()`)
   * `shrink(engine, seed)` — minimize a failing seed's config (shrink.py)
   * `EngineConfig(trace_ring=R)` + `Engine.ring_trace(result, lane)` —
     on-device last-R-events ring for post-mortems without replay

@@ -39,7 +39,10 @@ import os
 from ..compile_cache import enable_compile_cache
 from ..ops import find_free_slot, pop_earliest
 from ..ops.coverage import (
+    COV_BAND_AMNESIA,
+    COV_BAND_DUP,
     COV_SLOTS_LOG2_DEFAULT,
+    cov_band,
     cov_fold,
     cov_slot,
     empty_cov_map,
@@ -75,6 +78,11 @@ F_LOSS_END = 9
 F_DELAY_SPIKE = 10  # timed delay-spike window: ~10% of sends +1-5 virt s
 F_DELAY_END = 11    # (the device analogue of the host buggify delay,
 #                     reference sim/net/mod.rs:287-296)
+F_PAUSE = 12   # pause window: node frozen (state survives; deliveries
+F_RESUME = 13  # targeting it DEFER past resume, not drop) — the device
+#                analogue of Handle::pause (reference runtime/mod.rs)
+F_SKEW = 14      # clock-skew window: payload[2] is a q10 multiplier —
+F_SKEW_END = 15  # the node's timer delays are stretched/compressed
 
 # FaultPlan kind indices (op_apply = 2*kind)
 K_PAIR = 0
@@ -83,12 +91,39 @@ K_DIR = 2
 K_GROUP = 3
 K_STORM = 4
 K_DELAY = 5
+K_PAUSE = 6
+K_SKEW = 7
 
 # delay-spike parameters — the host fabric's buggify numbers
 # (net/__init__.py rand_delay: 10% of sends suspended 1-5 s)
 DELAY_PROB_U32 = int(0.1 * 0xFFFFFFFF)
 DELAY_EXTRA_MIN_US = 1_000_000
 DELAY_EXTRA_SPAN_US = 4_000_001
+
+# message duplication (FaultPlan.allow_dup): Bernoulli per successful
+# delivery-push, duplicate re-enqueued with an independently drawn
+# latency (the at-least-once property real networks have and loss-only
+# chaos never exercises)
+DUP_PROB_U32 = int(0.1 * 0xFFFFFFFF)
+
+# clock-skew factor: a q10 fixed-point timer-delay multiplier drawn
+# uniform in [SKEW_Q10_MIN, SKEW_Q10_MIN + SKEW_Q10_SPAN) — 0.5x..2.0x,
+# wide enough to break lease/heartbeat "my timer fires before your
+# timeout" assumptions in both directions. Applied 32-bit-exactly as
+#   scaled = (d >> 10) * q + (((d & 1023) * q) >> 10)
+# (no int64, no floats — the determinism rules).
+SKEW_Q10_ONE = 1024
+SKEW_Q10_MIN = 512
+SKEW_Q10_SPAN = 1536
+
+
+def skew_scale_us(delay_us, q10):
+    """Stretch/compress an int32 microsecond delay by the q10 factor,
+    exactly, within int32 (delay < ~2^21 s-scale values stay exact:
+    (d>>10)*q <= 2e7 and the remainder term <= 2.1e6)."""
+    d = jnp.asarray(delay_us).astype(jnp.int32)
+    q = jnp.asarray(q10).astype(jnp.int32)
+    return (d >> 10) * q + (((d & 1023) * q) >> 10)
 
 # Failure codes
 OK = 0
@@ -109,12 +144,17 @@ _DIGEST_M1 = 0x85EBCA6B
 
 # FaultPlan kind names, indexed by K_* — the fault-injection counter
 # labels used by run_stream stats / bench / audit output.
-FAULT_KIND_NAMES = ("pair", "kill", "dir", "group", "storm", "delay")
+FAULT_KIND_NAMES = ("pair", "kill", "dir", "group", "storm", "delay", "pause", "skew")
 
-# StreamCarry.fr_metrics layout: 6 per-kind injection totals (summed at
-# harvest), then queue / clogged-link / killed-node high-water marks
-# (maxed at harvest).
-FR_METRICS_LEN = len(FAULT_KIND_NAMES) + 3
+# Non-scheduled chaos injection counters (flight recorder): Bernoulli
+# message duplicates pushed, and strict (crash-with-amnesia) restarts
+# applied. They ride fr_metrics after the per-kind totals.
+FR_EXTRA_NAMES = ("dup", "amnesia")
+
+# StreamCarry.fr_metrics layout: per-kind injection totals, the extra
+# chaos counters (all summed at harvest), then queue / clogged-link /
+# killed-node high-water marks (maxed at harvest).
+FR_METRICS_LEN = len(FAULT_KIND_NAMES) + len(FR_EXTRA_NAMES) + 3
 
 
 def digest_fold(d0, d1, words):
@@ -178,11 +218,38 @@ class FaultPlan:
         analogue of the host fabric's buggified rand_delay, reference
         sim/net/mod.rs:287-296; late-but-delivered messages find
         timeout-handling bugs that loss cannot)
+      * pause: a pause window — the node is FROZEN, not killed: its
+        state survives untouched and every delivery targeting it
+        (timers and messages alike) is deferred past the resume time
+        instead of dropped (the device analogue of `Handle::pause`,
+        reference sim/runtime/mod.rs). Exercises the timeout paths
+        kill cannot: peers see silence, then the node comes back with
+        stale-but-intact state.
+      * skew: a per-node clock-skew window — while active, every timer
+        the node arms is stretched/compressed by a q10 factor drawn in
+        [0.5x, 2.0x) (payload[2]); leases expire late, heartbeats fire
+        early, election timeouts drift.
+
+    Plus two non-scheduled chaos gates:
+      * `allow_dup`: Bernoulli per-delivery message duplication — each
+        successfully pushed message has a DUP_PROB chance of a second
+        copy enqueued with an independently drawn latency (idempotency
+        chaos; the RNG block grows a tail section, recorded streams
+        stay byte-stable with the flag off)
+      * `strict_restart`: crash-with-amnesia — restart faults wipe
+        every node-state leaf the machine's `durable_spec()` contract
+        does not mark durable, instead of trusting the model's
+        hand-written restart hook ("node restarts but illegally kept
+        volatile state" is the classic DST finding class this makes
+        expressible)
 
     The legacy two-kind derivation (partition/kill only) is byte-stable:
     seeds found by earlier sweeps (e.g. the 66531 LOG_MATCHING
     regression) replay unchanged unless a new kind is enabled, which
-    switches the schedule to the v2 derivation.
+    switches the schedule to the v2 derivation. pause/skew ride the v2
+    derivation with one extra per-fault draw (the skew factor), taken
+    only when either flag is on — dir/group/storm/delay-era schedules
+    are untouched.
     """
 
     n_faults: int = 0
@@ -192,6 +259,10 @@ class FaultPlan:
     allow_group: bool = False
     allow_storm: bool = False
     allow_delay: bool = False  # timed delay-spike windows (buggify analogue)
+    allow_pause: bool = False  # pause/resume windows (freeze, defer deliveries)
+    allow_skew: bool = False   # per-node clock-skew windows (q10 timer scale)
+    allow_dup: bool = False    # Bernoulli per-delivery message duplication
+    strict_restart: bool = False  # crash-with-amnesia via Machine.durable_spec()
     storm_loss_u16: int = 52428  # ~80% loss while a storm is active
     t_min_us: int = 0
     t_max_us: int = 1_000_000
@@ -212,14 +283,25 @@ class FaultPlan:
             kinds.append(K_STORM)
         if self.allow_delay:
             kinds.append(K_DELAY)
+        if self.allow_pause:
+            kinds.append(K_PAUSE)
+        if self.allow_skew:
+            kinds.append(K_SKEW)
         return tuple(kinds)
 
     @property
     def uses_v2_kinds(self) -> bool:
         return (
             self.allow_dir_clog or self.allow_group or self.allow_storm
-            or self.allow_delay
+            or self.allow_delay or self.uses_window_kinds
         )
+
+    @property
+    def uses_window_kinds(self) -> bool:
+        """The PR-5 scheduled kinds: they add one draw (the skew q10
+        factor) to each fault's v2 derivation — kept behind this flag so
+        dir/group/storm/delay-era schedules replay byte-identically."""
+        return self.allow_pause or self.allow_skew
 
 
 @dataclasses.dataclass(frozen=True)
@@ -309,6 +391,11 @@ class LaneState:
     eq_valid: jax.Array  # bool[Q]
     clogged: jax.Array  # int32[N, CLOG_WORDS] packed rows (clog_packed) | bool[N, N]
     killed: jax.Array  # bool[N]
+    # pause/skew windows: int32[N] when the kind is enabled, int32[0]
+    # otherwise (the leaf exists so the pytree structure is uniform, but
+    # a disabled kind carries — and computes — nothing)
+    paused_until: jax.Array  # virtual us the node resumes at (0 = running)
+    skew_q10: jax.Array  # active q10 timer-delay multiplier (0 = none)
     nodes: Any
     ring: Any  # {} when trace_ring == 0, else dict of [R]/[R,P] arrays
     fr: Any  # {} unless flight_recorder: digest + checkpoint ring + metrics
@@ -425,11 +512,29 @@ class Engine:
                 "flight_recorder needs fr_digest_every >= 1 and "
                 "fr_digest_ring >= 1"
             )
-        if config.coverage and not 7 <= config.cov_slots_log2 <= 20:
+        if fp.strict_restart and fp.allow_kill and machine.durable_spec() is None:
             raise ValueError(
-                "coverage needs 7 <= cov_slots_log2 <= 20 (3 band bits "
-                "+ 3 phase bits + at least 1 mix bit; 2^20 slots = 1 MiB "
-                "per lane is already past any sane map size)"
+                f"strict_restart (crash-with-amnesia) needs "
+                f"{type(machine).__name__}.durable_spec() to declare the "
+                f"durable-state contract (which leaves survive restart)"
+            )
+        # Coverage banded-slot layout version: the band field grows to 4
+        # bits whenever any PR-5 chaos capability can occur (those are
+        # new configs by definition, so every historical map keeps its
+        # 3-bit layout and its golden slot constants).
+        self.cov_band_bits = (
+            4
+            if (fp.allow_pause or fp.allow_skew or fp.allow_dup
+                or fp.strict_restart)
+            else 3
+        )
+        min_log2 = self.cov_band_bits + 3 + 1
+        if config.coverage and not min_log2 <= config.cov_slots_log2 <= 20:
+            raise ValueError(
+                f"coverage needs {min_log2} <= cov_slots_log2 <= 20 "
+                f"({self.cov_band_bits} band bits + 3 phase bits + at "
+                f"least 1 mix bit; 2^20 slots = 1 MiB per lane is "
+                f"already past any sane map size)"
             )
         # Static step-RNG block layout + compute-elision flags: which
         # chaos draws this (config, machine) pair can ever consume.
@@ -446,6 +551,7 @@ class Engine:
             spike_possible=fp.allow_delay,
             delay_enabled=fp.allow_delay,
             restart_possible=fp.allow_kill,
+            dup_possible=fp.allow_dup,
         )
 
     # -- lane init -----------------------------------------------------------
@@ -543,6 +649,25 @@ class Engine:
                     jnp.where(kind == K_STORM, jnp.int32(fp.storm_loss_u16), a),
                 )
                 arg2 = jnp.where(kind == K_GROUP, mask_hi, b)
+                if fp.uses_window_kinds:
+                    # one extra draw — the skew q10 factor — taken only
+                    # when pause/skew are in the vocabulary, so every
+                    # dir/group/storm/delay-era schedule stays
+                    # byte-stable. Drawn unconditionally (constant draw
+                    # count) like every other v2 argument.
+                    k_faults, k8 = jax.random.split(k_faults)
+                    skew_q10 = jnp.int32(SKEW_Q10_MIN) + (
+                        jax.random.bits(k8, (), jnp.uint32)
+                        % jnp.uint32(SKEW_Q10_SPAN)
+                    ).astype(jnp.int32)
+                    # pause: arg2 carries the resume time (the undo
+                    # event's own timestamp), so the defer target needs
+                    # no extra state; skew: arg2 carries the factor
+                    arg2 = jnp.where(
+                        kind == K_PAUSE,
+                        t + dur,
+                        jnp.where(kind == K_SKEW, skew_q10, arg2),
+                    )
             for slot_off, (tt, op) in enumerate([(t, op_apply), (t + dur, op_undo)]):
                 i = n + 2 * f + slot_off
                 msk = slots == i
@@ -580,6 +705,8 @@ class Engine:
                 else jnp.zeros((n, n), bool)
             ),
             killed=jnp.zeros((n,), bool),
+            paused_until=jnp.zeros((n if fp.allow_pause else 0,), jnp.int32),
+            skew_q10=jnp.zeros((n if fp.allow_skew else 0,), jnp.int32),
             nodes=nodes,
             ring=self._empty_ring(),
             fr=self._empty_fr(),
@@ -606,6 +733,8 @@ class Engine:
             "ck_d0": jnp.zeros((r,), jnp.uint32),
             "ck_d1": jnp.zeros((r,), jnp.uint32),
             "inj": jnp.zeros((len(FAULT_KIND_NAMES),), jnp.int32),
+            "dup": jnp.int32(0),
+            "amnesia": jnp.int32(0),
             "q_hwm": jnp.int32(0),
             "clog_hwm": jnp.int32(0),
             "kill_hwm": jnp.int32(0),
@@ -675,7 +804,27 @@ class Engine:
         live = any_valid if active is None else any_valid & active
         horizon_hit = live & (new_now >= hz)
         process = live & ~horizon_hit
+        node_alive = ~s.killed[ev_node]
+        # pause windows: a handler event targeting a paused (alive) node
+        # is DEFERRED — the popped slot stays valid and only its time
+        # moves to the node's resume point (the state survives, nothing
+        # is processed, nothing is dropped). Kill still dominates: a
+        # dead node's events are consumed as before. The deferred pop
+        # itself is a popped event (trace ring / digest / coverage see
+        # it) — host replay pops it identically, so the contract holds.
+        if cfg.faults.allow_pause:
+            node_resume_us = s.paused_until[ev_node]
+            defer = (
+                process
+                & (ev_kind != EV_FAULT)
+                & node_alive
+                & (node_resume_us > new_now)
+            )
+        else:
+            defer = None
         pop_mask = (jnp.arange(s.eq_valid.shape[0]) == idx) & live
+        if defer is not None:
+            pop_mask = pop_mask & ~defer
         eq_valid = s.eq_valid & ~pop_mask
 
         # on-device trace ring: record every popped event (same condition
@@ -707,15 +856,15 @@ class Engine:
             # (v3's lane key is immutable, nothing to gate)
             key = jnp.where(active, key, s.rng_key)
 
-        node_alive = ~s.killed[ev_node]
-
         def timer_branch(_):
             nodes, outbox = m.on_timer(s.nodes, ev_node, ev_payload[0], new_now, rand_u32)
-            return nodes, outbox, s.clogged, s.killed, s.storm_loss, s.delay_spike, jnp.int32(-1)
+            return (nodes, outbox, s.clogged, s.killed, s.storm_loss,
+                    s.delay_spike, s.paused_until, s.skew_q10, jnp.int32(-1))
 
         def msg_branch(_):
             nodes, outbox = m.on_message(s.nodes, ev_node, ev_src, ev_payload, new_now, rand_u32)
-            return nodes, outbox, s.clogged, s.killed, s.storm_loss, s.delay_spike, jnp.int32(-1)
+            return (nodes, outbox, s.clogged, s.killed, s.storm_loss,
+                    s.delay_spike, s.paused_until, s.skew_q10, jnp.int32(-1))
 
         def fault_branch(_):
             op, a, b = ev_payload[0], ev_payload[1], ev_payload[2]
@@ -793,27 +942,61 @@ class Engine:
                 jnp.int32(1),
                 jnp.where(op == F_DELAY_END, jnp.int32(0), s.delay_spike),
             ).astype(jnp.int32)
+            # pause window: arg2 (`b`) carries the resume time the
+            # schedule derivation baked in — deferral needs no clock
+            # state beyond this per-node word
+            paused = s.paused_until
+            if cfg.faults.allow_pause:
+                paused = jnp.where(
+                    (op == F_PAUSE) & a_mask,
+                    b,
+                    jnp.where((op == F_RESUME) & a_mask, jnp.int32(0), paused),
+                ).astype(jnp.int32)
+            # clock-skew window: arg2 (`b`) is the drawn q10 factor
+            skew = s.skew_q10
+            if cfg.faults.allow_skew:
+                skew = jnp.where(
+                    (op == F_SKEW) & a_mask,
+                    b,
+                    jnp.where((op == F_SKEW_END) & a_mask, jnp.int32(0), skew),
+                ).astype(jnp.int32)
             # cond folded into the machine's own row masks — no full-tree
             # select here (XLA CSEs it inside the fused loop, but eager
             # step_batch paid ~30% for it, and masked writes are strictly
             # less work for any backend)
-            nodes = m.restart_node_if(s.nodes, a, op == F_RESTART, k_restart)
+            nodes = m.restart_node_if(
+                s.nodes, a, op == F_RESTART, k_restart,
+                strict=cfg.faults.strict_restart,
+            )
             boot_node = jnp.where(op == F_RESTART, a, jnp.int32(-1))
-            return nodes, m.empty_outbox(), clogged, killed, storm, delay, boot_node
+            return (nodes, m.empty_outbox(), clogged, killed, storm, delay,
+                    paused, skew, boot_node)
 
-        nodes, outbox, clogged, killed, storm_loss, delay_spike, boot_node = lax.switch(
+        (nodes, outbox, clogged, killed, storm_loss, delay_spike,
+         paused_until, skew_q10, boot_node) = lax.switch(
             ev_kind, [timer_branch, msg_branch, fault_branch], None
         )
 
         # Killed nodes process nothing (reference: killed node's tasks are
-        # dropped); fault events always apply.
+        # dropped); fault events always apply. Deferred events (pause
+        # windows) are not processed either — they re-deliver at resume.
         is_handler = ev_kind != EV_FAULT
         effective = process & (node_alive | ~is_handler)
+        if defer is not None:
+            effective = effective & ~defer
         nodes = tree_where(effective, nodes, s.nodes)
         clogged = jnp.where(effective, clogged, s.clogged)
         killed = jnp.where(effective, killed, s.killed)
         storm_loss = jnp.where(effective, storm_loss, s.storm_loss)
         delay_spike = jnp.where(effective, delay_spike, s.delay_spike)
+        if cfg.faults.allow_pause:
+            paused_until = jnp.where(effective, paused_until, s.paused_until)
+        else:
+            paused_until = s.paused_until
+        if cfg.faults.allow_skew:
+            skew_q10 = jnp.where(effective, skew_q10, s.skew_q10)
+        else:
+            skew_q10 = s.skew_q10
         outbox_valid_msgs = outbox.msg_valid & effective
         outbox_valid_timers = outbox.timer_valid & effective
 
@@ -827,6 +1010,16 @@ class Engine:
             "payload": s.eq_payload,
             "valid": eq_valid,
         }
+        if defer is not None:
+            # deferred delivery: rewrite the (still-valid) popped slot's
+            # time to the node's resume point. Seq is untouched — at the
+            # resume instant `paused_until > now` is already false, so
+            # the event delivers regardless of its order relative to the
+            # F_RESUME event, and same-time deferred events keep their
+            # original relative order. No free slot is consumed, so
+            # deferral can never overflow the queue.
+            defer_slot = (jnp.arange(s.eq_valid.shape[0]) == idx) & defer
+            eq["time"] = jnp.where(defer_slot, node_resume_us, eq["time"])
         next_seq = s.next_seq
         failed = s.failed
         fail_code = s.fail_code
@@ -858,6 +1051,14 @@ class Engine:
             spike_mag_bits = step_words[
                 layout.spike_off + m.MAX_MSGS : layout.spike_off + 2 * m.MAX_MSGS
             ]
+        if layout.dup_active:
+            # duplication gate + fresh-latency words (tail section of
+            # the block — recorded streams are untouched with dup off)
+            dup_bits = step_words[layout.dup_off : layout.dup_off + m.MAX_MSGS]
+            dup_lat_bits = step_words[
+                layout.dup_off + m.MAX_MSGS : layout.dup_off + 2 * m.MAX_MSGS
+            ]
+            n_dups = jnp.int32(0)
         # the handling node's outbound clog row, read ONCE (pre-fault
         # state, matching the unpacked path's s.clogged[ev_node, dst])
         # and expanded to bool[N] so each message pays the same tiny
@@ -895,9 +1096,36 @@ class Engine:
             eq = _push(eq, slot, do_push, new_now + latency, next_seq, EV_MSG, dst, ev_node, outbox.msg_payload[mi])
             next_seq = next_seq + jnp.where(do_push, 1, 0)
             msg_count = msg_count + jnp.where(do_push, 1, 0)
+            if layout.dup_active:
+                # Bernoulli duplicate of a successfully pushed message,
+                # re-enqueued with an independently drawn latency (the
+                # idempotency chaos loss-only vocabularies can't
+                # express). Same overflow accounting as any push.
+                want_dup = do_push & (dup_bits[mi] < jnp.uint32(DUP_PROB_U32))
+                dslot, dfree = find_free_slot(eq["valid"])
+                doverflow = want_dup & ~dfree
+                failed = failed | doverflow
+                fail_code = jnp.where(doverflow, jnp.int32(OVERFLOW), fail_code)
+                want_dup = want_dup & dfree
+                dup_latency = jnp.int32(cfg.latency_min_us) + (
+                    dup_lat_bits[mi] % jnp.uint32(lat_span)
+                ).astype(jnp.int32)
+                eq = _push(
+                    eq, dslot, want_dup, new_now + dup_latency, next_seq,
+                    EV_MSG, dst, ev_node, outbox.msg_payload[mi],
+                )
+                next_seq = next_seq + jnp.where(want_dup, 1, 0)
+                msg_count = msg_count + jnp.where(want_dup, 1, 0)
+                n_dups = n_dups + want_dup.astype(jnp.int32)
 
         # -- push timers (for the handling node) ----------------------------
         slot0 = jnp.arange(m.PAYLOAD_WIDTH) == 0
+        if cfg.faults.allow_skew:
+            # clock-skew window: the handling node's armed timers are
+            # stretched/compressed by its active q10 factor (read from
+            # the pre-step state — handler events never change skew, and
+            # fault events arm no timers, so pre == post here)
+            node_skew_q10 = s.skew_q10[ev_node]
         for ti in range(m.MAX_TIMERS):
             want = outbox_valid_timers[ti]
             slot, has_free = find_free_slot(eq["valid"])
@@ -906,8 +1134,15 @@ class Engine:
             fail_code = jnp.where(overflow, jnp.int32(OVERFLOW), fail_code)
             want = want & has_free
             tpay = jnp.where(slot0, outbox.timer_id[ti], 0).astype(jnp.int32)
+            t_delay = outbox.timer_delay_us[ti]
+            if cfg.faults.allow_skew:
+                t_delay = jnp.where(
+                    node_skew_q10 > 0,
+                    skew_scale_us(t_delay, node_skew_q10),
+                    t_delay,
+                )
             eq = _push(
-                eq, slot, want, new_now + outbox.timer_delay_us[ti], next_seq,
+                eq, slot, want, new_now + t_delay, next_seq,
                 EV_TIMER, ev_node, jnp.int32(-1), tpay,
             )
             next_seq = next_seq + jnp.where(want, 1, 0)
@@ -957,6 +1192,16 @@ class Engine:
             inj = fr["inj"] + (
                 (jnp.arange(len(FAULT_KIND_NAMES)) == kind_idx) & is_inj
             ).astype(jnp.int32)
+            # non-scheduled chaos counters: duplicates pushed this step,
+            # crash-with-amnesia wipes applied (strict restarts)
+            fr_dup = fr["dup"]
+            if layout.dup_active:
+                fr_dup = fr_dup + n_dups
+            fr_amnesia = fr["amnesia"]
+            if cfg.faults.strict_restart:
+                fr_amnesia = fr_amnesia + (
+                    process & (ev_kind == EV_FAULT) & (ev_payload[0] == F_RESTART)
+                ).astype(jnp.int32)
             # occupancy high-water marks on the post-step state (frozen
             # lanes' state is unchanged, so their marks are stable)
             n_clog = (
@@ -971,6 +1216,8 @@ class Engine:
                 "ck_d0": jnp.where(ck_slot, d0, fr["ck_d0"]),
                 "ck_d1": jnp.where(ck_slot, d1, fr["ck_d1"]),
                 "inj": inj,
+                "dup": fr_dup,
+                "amnesia": fr_amnesia,
                 "q_hwm": jnp.maximum(
                     fr["q_hwm"], eq["valid"].sum().astype(jnp.int32)
                 ),
@@ -999,16 +1246,42 @@ class Engine:
                 | ((storm_loss > 0).astype(jnp.int32) << 4)
                 | ((delay_spike > 0).astype(jnp.int32) << 5)
             )
+            # new chaos windows extend the context word only when their
+            # kind is enabled — legacy configs hash identical inputs
+            if cfg.faults.allow_pause:
+                ctx = ctx | (jnp.any(paused_until > 0).astype(jnp.int32) << 6)
+            if cfg.faults.allow_skew:
+                ctx = ctx | (jnp.any(skew_q10 > 0).astype(jnp.int32) << 7)
             # event discriminant: payload[0] for msg (message type) and
             # fault (op) events; timers fold 0 — timer ids are
             # epoch-encoded, and counting every restart epoch as a new
             # scenario would inflate the map
             op_word = jnp.where(ev_kind == EV_TIMER, jnp.int32(0), ev_payload[0])
+            band = cov_band(ev_kind, op_word, self.cov_band_bits)
+            if cfg.faults.strict_restart:
+                # a strict restart is a different scenario class than a
+                # plain kill/restart: route it to the amnesia band
+                band = jnp.where(
+                    (ev_kind == EV_FAULT) & (ev_payload[0] == F_RESTART),
+                    jnp.int32(COV_BAND_AMNESIA),
+                    band,
+                )
             slot = cov_slot(
-                abs_word, ev_kind, ev_node, op_word, ctx, cfg.cov_slots_log2
+                abs_word, ev_kind, ev_node, op_word, ctx, cfg.cov_slots_log2,
+                band_bits=self.cov_band_bits, band=band,
             )
             # same condition as the trace ring / digest: popped events
             cov = {"map": cov_fold(cov["map"], slot, live)}
+            if layout.dup_active:
+                # synthetic dup band: a step that enqueued >= 1 duplicate
+                # is its own scenario class (one extra word fold, only
+                # when the gate is on)
+                dup_slot = cov_slot(
+                    abs_word, ev_kind, ev_node, n_dups, ctx,
+                    cfg.cov_slots_log2, band_bits=self.cov_band_bits,
+                    band=jnp.int32(COV_BAND_DUP),
+                )
+                cov = {"map": cov_fold(cov["map"], dup_slot, live & (n_dups > 0))}
 
         # -- invariants / termination ---------------------------------------
         ok, code = m.invariant(nodes, new_now)
@@ -1046,6 +1319,8 @@ class Engine:
             eq_valid=eq["valid"],
             clogged=clogged,
             killed=killed,
+            paused_until=paused_until,
+            skew_q10=skew_q10,
             nodes=nodes,
             ring=ring,
             fr=fr,
@@ -1268,19 +1543,26 @@ class Engine:
             if self.config.flight_recorder:
                 frs = state.fr
                 nk = len(FAULT_KIND_NAMES)
+                ne = len(FR_EXTRA_NAMES)
                 inj_tot = fr_metrics[:nk] + (
                     frs["inj"] * done[:, None].astype(jnp.int32)
                 ).sum(axis=0)
+                extra_tot = jnp.stack(
+                    [
+                        fr_metrics[nk + i] + jnp.where(done, frs[k], 0).sum()
+                        for i, k in enumerate(FR_EXTRA_NAMES)
+                    ]
+                )
                 hwm = jnp.stack(
                     [
                         jnp.maximum(
-                            fr_metrics[nk + i],
+                            fr_metrics[nk + ne + i],
                             jnp.where(done, frs[k], 0).max(),
                         )
                         for i, k in enumerate(("q_hwm", "clog_hwm", "kill_hwm"))
                     ]
                 )
-                fr_metrics = jnp.concatenate([inj_tot, hwm])
+                fr_metrics = jnp.concatenate([inj_tot, extra_tot, hwm])
 
             # coverage rides the harvest too: OR every lane's bit map
             # into the global vector. ALL lanes, not just done ones —
@@ -1424,15 +1706,41 @@ class Engine:
         failing: list = []
         infra: list = []
         abandoned: list = []
-        stats = {"host_syncs": 0, "drains": 0, "dispatches": 0}
+        stats = {"host_syncs": 0, "drains": 0, "dispatches": 0,
+                 "dispatch_retries": 0}
         # (completed, slots_hit) at every blocking poll: the live
         # coverage curve — its deltas are the "new slots this poll
         # cycle" signal the plateau detector and StatsEmitter consume
         cov_curve: list = []
 
+        # Transient-backend retry: device dispatches and the blocking
+        # counter/ring reads ride a small retry-with-backoff so a
+        # plugin/tunnel hiccup doesn't abort an hour-long hunt; a
+        # non-transient error (including "donated buffer deleted" — a
+        # dispatch that died AFTER consuming its carry cannot be safely
+        # replayed) propagates immediately, and exhausted retries fail
+        # loud with the attempt count. Counted in stats.
+        from .._backend_watchdog import retry_transient
+
+        def _dispatch(what, fn, *fn_args):
+            def on_retry(attempt, exc, delay_s):
+                stats["dispatch_retries"] += 1
+                import logging
+
+                logging.getLogger("madsim_tpu.stream").warning(
+                    "transient backend error on %s (attempt %d, retrying "
+                    "in %.2fs): %s", what, attempt, delay_s, exc,
+                )
+
+            return retry_transient(
+                lambda: fn(*fn_args), what=what, on_retry=on_retry
+            )
+
         def drain(c: StreamCarry) -> StreamCarry:
-            f_seeds, f_codes, f_n, a_seeds, a_n = jax.device_get(
-                (c.fail_seeds, c.fail_codes, c.fail_count, c.ab_seeds, c.ab_count)
+            f_seeds, f_codes, f_n, a_seeds, a_n = _dispatch(
+                "ring drain",
+                jax.device_get,
+                (c.fail_seeds, c.fail_codes, c.fail_count, c.ab_seeds, c.ab_count),
             )
             stats["drains"] += 1
             stats["host_syncs"] += 1
@@ -1448,7 +1756,7 @@ class Engine:
 
         def poll(c: StreamCarry):
             """The blocking device->host sync: one small counters read."""
-            counters = np.asarray(jax.device_get(c.counters))
+            counters = np.asarray(_dispatch("counters poll", jax.device_get, c.counters))
             stats["host_syncs"] += 1
             if counters[4]:
                 raise RuntimeError(
@@ -1473,7 +1781,7 @@ class Engine:
             while completed < n_seeds and stats["dispatches"] < max_dispatch:
                 # async dispatch: returns immediately, device work queues
                 # behind the donated carry chain
-                carry = supersegment(carry, need)
+                carry = _dispatch("supersegment dispatch", supersegment, carry, need)
                 stats["dispatches"] += 1
                 in_flight += 1
                 if in_flight >= dispatch_depth:
@@ -1488,7 +1796,7 @@ class Engine:
         else:
             # r5 executor: one blocking counters read per segment
             while completed < n_seeds and stats["dispatches"] < max_segments:
-                carry = segment(carry)
+                carry = _dispatch("segment dispatch", segment, carry)
                 stats["dispatches"] += 1
                 counters = poll(carry)
                 completed = int(counters[0])
@@ -1524,7 +1832,10 @@ class Engine:
             )
             cov_stats = {
                 "coverage": {
-                    **coverage_dict(cov_map_np, self.config.cov_slots_log2),
+                    **coverage_dict(
+                        cov_map_np, self.config.cov_slots_log2,
+                        band_bits=self.cov_band_bits,
+                    ),
                     "curve": cov_curve,
                 }
             }

@@ -158,9 +158,49 @@ class Machine:
         fresh = self.init_node(nodes, i, rng_key)
         return jax.tree.map(lambda c, f: jnp.where(cond, f, c), nodes, fresh)
 
-    def restart_node_if(self, nodes: Any, i, cond, rng_key) -> Any:
-        """Engine-facing restart dispatch — do NOT override. Picks the
-        restart hook by MRO position so both authoring styles work:
+    def durable_spec(self) -> Any:
+        """Optional durable-state contract for crash-with-amnesia faults
+        (`FaultPlan.strict_restart`): a pytree CONGRUENT to `init()`'s
+        node state whose every leaf is a python bool — True marks a
+        leaf as durable (survives restart: stable storage / WAL /
+        fsynced log), False as volatile (a restarted node must lose
+        it). The engine wipes volatile leaves generically from a fresh
+        `init()` in `restart_node_if(..., strict=True)` — the model's
+        hand-written `restart_if` is bypassed, so a machine whose
+        restart code quietly keeps state its own contract calls
+        volatile can no longer hide it (the classic DST finding class:
+        "node restarts but illegally kept volatile state").
+
+        Default None: no contract declared — the engine refuses
+        `strict_restart` for such machines rather than guessing.
+        """
+        return None
+
+    def amnesia_restart_if(self, nodes: Any, i, cond, rng_key) -> Any:
+        """Crash-with-amnesia restart: reset every leaf `durable_spec()`
+        marks volatile to its fresh-`init()` value for node row i (a
+        masked row write per volatile leaf; durable leaves cost nothing
+        — the keep is a static python branch)."""
+        spec = self.durable_spec()
+        if spec is None:
+            raise ValueError(
+                f"{type(self).__name__} declares no durable_spec(); "
+                f"strict_restart (crash-with-amnesia) needs the durable-"
+                f"state contract to know which leaves to wipe"
+            )
+        fresh = self.init(rng_key)
+        return jax.tree.map(
+            lambda durable, cur, f: cur if durable else set_at(cur, i, f, cond),
+            spec, nodes, fresh,
+        )
+
+    def restart_node_if(self, nodes: Any, i, cond, rng_key, strict: bool = False) -> Any:
+        """Engine-facing restart dispatch — do NOT override. With
+        `strict` (static, from `FaultPlan.strict_restart`) the generic
+        crash-with-amnesia wipe runs instead of the model's own restart
+        hook — the durable_spec contract, not the handler code, decides
+        what survives. Otherwise picks the restart hook by MRO position
+        so both authoring styles work:
 
           * a subclass overriding `restart_if` (the fast path) wins when
             it is at least as derived as any `init_node` override;
@@ -171,6 +211,8 @@ class Machine:
             guard inside each model's restart_if can mutually recurse
             with init_node shims that delegate to restart_if.
         """
+        if strict:
+            return self.amnesia_restart_if(nodes, i, cond, rng_key)
         mro = type(self).__mro__
 
         def hook_owner(name):

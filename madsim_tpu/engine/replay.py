@@ -141,7 +141,13 @@ def _trace_affecting_key(engine: Engine) -> tuple:
         cfg.flight_recorder,
         cfg.fr_digest_every,
         cfg.fr_digest_ring,
-        engine._rng_layout,  # stream version + word-block layout
+        # PR-5 chaos gates compiled INTO the step (defer logic, skew
+        # scaling, amnesia restart) — unlike the legacy kinds, which
+        # only shape the schedule in the initial state
+        cfg.faults.allow_pause,
+        cfg.faults.allow_skew,
+        cfg.faults.strict_restart,
+        engine._rng_layout,  # stream version + word-block layout (incl. dup)
         engine.use_pallas_pop,
     )
 

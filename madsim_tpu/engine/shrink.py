@@ -8,6 +8,12 @@ to a minimal one that still reproduces the same failure code:
     independent key-chain position, so a plan with n_faults=f keeps the
     first f faults bit-identical — candidates are honest prefixes)
   * packet loss off (if it was on)
+  * fault-KIND ablation: each enabled `allow_*` chaos flag (and
+    `strict_restart`) is tried off — candidates whose honest replay
+    still fails with the same code drop the kind, so the result names
+    the minimal chaos vocabulary. (Turning a scheduled kind off changes
+    the remaining faults' drawn parameters — that's fine: every
+    candidate is verified by a full replay, never assumed.)
   * horizon cut to just past the failure time
   * step budget cut to just past the failing step
 
@@ -25,6 +31,23 @@ from .core import Engine, EngineConfig
 from .replay import ReplayResult, replay
 
 
+# Ablation order: newest/most-exotic kinds first so the reported
+# minimal set leans on the legacy vocabulary when possible. Each entry
+# is (report name, FaultPlan field).
+ABLATABLE_KINDS = (
+    ("delay", "allow_delay"),
+    ("storm", "allow_storm"),
+    ("group", "allow_group"),
+    ("dir", "allow_dir_clog"),
+    ("pause", "allow_pause"),
+    ("skew", "allow_skew"),
+    ("dup", "allow_dup"),
+    ("strict-restart", "strict_restart"),
+    ("kill", "allow_kill"),
+    ("pair", "allow_partition"),
+)
+
+
 @dataclasses.dataclass
 class ShrinkResult:
     seed: int
@@ -35,6 +58,7 @@ class ShrinkResult:
                             # (itself a sufficient --max-steps budget)
     fail_time_us: int
     attempts: int           # replays spent shrinking
+    kinds_removed: tuple = ()  # chaos flags ablated off (honest replays)
 
     def summary(self) -> str:
         o, s = self.original, self.shrunk
@@ -43,6 +67,8 @@ class ShrinkResult:
             parts.append(f"faults {o.faults.n_faults} -> {s.faults.n_faults}")
         if s.packet_loss_rate != o.packet_loss_rate:
             parts.append(f"loss {o.packet_loss_rate} -> 0")
+        if self.kinds_removed:
+            parts.append("kinds -" + ",-".join(self.kinds_removed))
         if s.horizon_us != o.horizon_us:
             parts.append(f"horizon {o.horizon_us}us -> {s.horizon_us}us")
         changed = "; ".join(parts) if parts else "config already minimal"
@@ -96,7 +122,26 @@ def shrink(engine: Engine, seed: int, max_steps: int = 10_000) -> ShrinkResult:
         if rp is not None:
             cfg, best = cand_cfg, rp
 
-    # 3. horizon just past the failure (sound by construction — events at
+    # 3. fault-kind ablation: try each enabled chaos flag off. Honest —
+    #    every candidate is a full replay required to reproduce the SAME
+    #    fail code; flags whose removal changes the outcome stay. A
+    #    scheduled plan must keep at least one kind (the constructor
+    #    rejects an empty vocabulary with n_faults > 0).
+    kinds_removed = []
+    for kind_name, field in ABLATABLE_KINDS:
+        if not getattr(cfg.faults, field):
+            continue
+        cand_faults = dataclasses.replace(cfg.faults, **{field: False})
+        if cand_faults.n_faults > 0 and not cand_faults.enabled_kinds():
+            continue
+        cand_cfg = dataclasses.replace(cfg, faults=cand_faults)
+        attempts += 1
+        rp = _fails_same(Engine(engine.machine, cand_cfg), seed, max_steps, code)
+        if rp is not None:
+            cfg, best = cand_cfg, rp
+            kinds_removed.append(kind_name)
+
+    # 4. horizon just past the failure (sound by construction — events at
     #    t < horizon are unaffected by the horizon value — but verified)
     fail_t = int(best.state.now_us)
     if fail_t + 1 < cfg.horizon_us:
@@ -106,7 +151,7 @@ def shrink(engine: Engine, seed: int, max_steps: int = 10_000) -> ShrinkResult:
         if rp is not None:
             cfg, best = cand_cfg, rp
 
-    # 4. the exact failing step count is itself a sufficient step budget
+    # 5. the exact failing step count is itself a sufficient step budget
     steps = int(best.state.step)
     return ShrinkResult(
         seed=seed,
@@ -116,4 +161,5 @@ def shrink(engine: Engine, seed: int, max_steps: int = 10_000) -> ShrinkResult:
         steps=steps,
         fail_time_us=int(best.state.now_us),
         attempts=attempts,
+        kinds_removed=tuple(kinds_removed),
     )

@@ -132,6 +132,19 @@ class EtcdMachine(Machine):
         state. Epochs always survive (timer-chain bookkeeping)."""
         return self.restart_if(nodes, i, jnp.bool_(True), rng_key)
 
+    def durable_spec(self) -> EtcdState:
+        """Crash-with-amnesia contract: the server store (revision /
+        generation / election / leases) is raft-backed and durable,
+        client session state is volatile; epochs (timer bookkeeping)
+        and the ghost violation flag survive."""
+        return EtcdState(
+            srv_rev=True, srv_gen=True, srv_owner=True,
+            srv_lease_expiry=True,
+            cl_has_lease=False, cl_deadline=False, cl_leader=False,
+            cl_gen=False, cl_writes=False, cl_max_rev=False,
+            epoch=True, violated=True,
+        )
+
     def restart_if(self, nodes: EtcdState, i, cond, rng_key) -> EtcdState:
         n = self.NUM_NODES
         row = (jnp.arange(n) == i) & cond

@@ -78,6 +78,21 @@ class KvMachine(Machine):
         """Restart: the server's store is durable; client sessions reset."""
         return self.restart_if(nodes, i, jnp.bool_(True), rng_key)
 
+    def durable_spec(self) -> KvState:
+        """Crash-with-amnesia contract: the store (version/value) is
+        durable, client session state is volatile; the ghost violation
+        flag survives (spec state, not node memory)."""
+        return KvState(
+            version=True,
+            value=True,
+            acked_version=False,
+            next_val=False,
+            pending_kind=False,
+            pending_val=False,
+            reqid=False,
+            stale=True,
+        )
+
     def restart_if(self, nodes: KvState, i, cond, rng_key) -> KvState:
         is_server = i == SERVER
         mask = (jnp.arange(self.NUM_NODES) == i) & ~is_server & cond

@@ -106,6 +106,19 @@ class PaxosMachine(Machine):
             bad=jnp.zeros((n,), bool),
         )
 
+    def durable_spec(self) -> PaxosState:
+        """Crash-with-amnesia contract: acceptor state (promised /
+        accepted) is Paxos stable storage, the proposer's round counter
+        recovers from disk, the in-flight phase is volatile; the ghost
+        chosen-register and violation flag are spec state."""
+        return PaxosState(
+            promised=True, acc_ballot=True, acc_value=True,
+            phase=False, ballot=False, round=True,
+            promises=False, best_ballot=False, best_value=False,
+            accepts=False, decided=False,
+            chosen_any=True, chosen_val=True, bad=True,
+        )
+
     def restart_if(self, nodes: PaxosState, i, cond, rng_key) -> PaxosState:
         """Kill/restart: acceptor state is stable storage; the proposer
         side restarts idle (it will re-propose from its round counter,

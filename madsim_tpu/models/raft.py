@@ -93,7 +93,37 @@ class RaftMachine(Machine):
     # round-3 new-fault-kinds demo, see tests/test_engine.py).
     QUORUM_OFF_BY_ONE = False
 
+    # Durable-state contract bug (the crash-with-amnesia demo). False
+    # (correct, Raft §5.1): term/votedFor/log live in stable storage,
+    # commitIndex is volatile. True flips the log and the commit index:
+    # the node persists its commitIndex but NOT the log backing it —
+    # the classic "fsync the metadata, forget the data" storage bug. A
+    # plain kill/restart can't see it (the model's restart_if still
+    # hand-resets the right fields); FaultPlan(strict_restart=True)
+    # makes the CONTRACT the restart semantics, so the first restart
+    # after any commit leaves commit pointing at a wiped log — caught
+    # by the existing LogMatching checker (code 102), no new invariant
+    # needed.
+    PERSIST_COMMIT_NOT_LOG = False
+
+    # Vote tally semantics. False (correct, Raft §5.2: a candidate wins
+    # when a majority of SERVERS grant — distinct voters): `votes` holds
+    # a bitmask of granting node ids (self-vote included) and the win
+    # check popcounts it, so a re-delivered grant is idempotent. True
+    # reproduces the duplicate-vote tally bug this model silently had
+    # until PR-5's message-duplication chaos (FaultPlan.allow_dup) found
+    # it: `votes` is a plain per-message counter, an at-least-once
+    # network delivers one grant twice, and two leaders share a term
+    # (ELECTION_SAFETY, code 101). Identical behavior on exactly-once
+    # networks either way — every recorded no-dup seed replays unchanged.
+    DUP_VOTE_COUNT = False
+
     def __init__(self, num_nodes: int = 5, log_capacity: int = 8):
+        if num_nodes > 31:
+            raise ValueError(
+                "RaftMachine tracks granting voters as an int32 bitmask "
+                "(dup-safe tally, Raft §5.2); num_nodes must be <= 31"
+            )
         self.NUM_NODES = num_nodes
         self.MAX_MSGS = num_nodes - 1
         self.log_capacity = log_capacity
@@ -122,6 +152,28 @@ class RaftMachine(Machine):
         """Restart: persistent state survives, volatile resets
         (Raft §5.1 stable storage semantics)."""
         return self.restart_if(nodes, i, jnp.bool_(True), rng_key)
+
+    def durable_spec(self) -> RaftState:
+        """Crash-with-amnesia contract (`FaultPlan.strict_restart`):
+        term/votedFor/log are stable storage, the timer epoch is
+        bookkeeping that must survive (it dies with the node's timers
+        otherwise), everything else is volatile. The generic wipe under
+        this spec is leaf-for-leaf identical to `restart_if` — strict
+        ON/OFF is bit-identical for the honest machine (tests assert)."""
+        log_durable = not self.PERSIST_COMMIT_NOT_LOG
+        return RaftState(
+            term=True,
+            voted_for=True,
+            log_term=log_durable,
+            log_len=log_durable,
+            epoch=True,
+            role=False,
+            votes=False,
+            elec_deadline=False,
+            commit=bool(self.PERSIST_COMMIT_NOT_LOG),
+            next_idx=False,
+            match_idx=False,
+        )
 
     def restart_if(self, nodes: RaftState, i, cond, rng_key) -> RaftState:
         """Masked restart: cond folds into the row mask, so the engine's
@@ -155,6 +207,24 @@ class RaftMachine(Machine):
 
     def _tid(self, nodes, node, base):
         return jnp.int32(base) + 4 * nodes.epoch[node]
+
+    # vote-tally representation (see DUP_VOTE_COUNT): bitmask of voter
+    # ids by default, plain counter for the seeded buggy variant
+
+    def _vote_init(self, node):
+        if self.DUP_VOTE_COUNT:
+            return jnp.int32(1)
+        return jnp.int32(1) << node
+
+    def _vote_add(self, votes, src, counts):
+        if self.DUP_VOTE_COUNT:
+            return votes + jnp.where(counts, 1, 0)
+        return jnp.where(counts, votes | (jnp.int32(1) << src), votes)
+
+    def _vote_count(self, votes):
+        if self.DUP_VOTE_COUNT:
+            return votes
+        return lax.population_count(votes.astype(jnp.uint32)).astype(jnp.int32)
 
     # -- timers --------------------------------------------------------------
 
@@ -193,7 +263,7 @@ class RaftMachine(Machine):
             term=jnp.where(start, new_term, nodes.term[node]),
             role=jnp.where(start, CANDIDATE, nodes.role[node]),
             voted_for=jnp.where(start, node, nodes.voted_for[node]),
-            votes=jnp.where(start, 1, nodes.votes[node]),
+            votes=jnp.where(start, self._vote_init(node), nodes.votes[node]),
             elec_deadline=jnp.where(start, now_us + timeout2, nodes.elec_deadline[node]),
         )
         outbox = set_timer_if(
@@ -294,8 +364,12 @@ class RaftMachine(Machine):
                 voted_for=jnp.where(newer, -1, nodes.voted_for[node]),
             )
             counts = (t == nodes.term[node]) & (nodes.role[node] == CANDIDATE) & (granted == 1)
-            new_votes = nodes.votes[node] + jnp.where(counts, 1, 0)
-            win = counts & (new_votes >= self.majority) & (nodes.role[node] == CANDIDATE)
+            new_votes = self._vote_add(nodes.votes[node], src, counts)
+            win = (
+                counts
+                & (self._vote_count(new_votes) >= self.majority)
+                & (nodes.role[node] == CANDIDATE)
+            )
             n = self.NUM_NODES
             nodes = update_node(nodes, node, votes=new_votes, role=jnp.where(win, LEADER, nodes.role[node]))
             # leader volatile state

@@ -100,6 +100,15 @@ class TwoPcMachine(Machine):
         older hook inherit the right durability split)."""
         return self.restart_if(nodes, i, jnp.bool_(True), rng_key)
 
+    def durable_spec(self) -> TwoPcState:
+        """Crash-with-amnesia contract: every WAL (decision/vote/outcome
+        logs + the txn counter) is durable, in-flight vote/ack
+        collection is volatile."""
+        return TwoPcState(
+            cur_txn=True, decision=True, voted=True, outcome=True,
+            votes_recv=False, votes_yes=False, acks=False,
+        )
+
     def restart_if(self, nodes: TwoPcState, i, cond, rng_key) -> TwoPcState:
         """Logs are durable; only the in-flight collection state resets."""
         mask = (jnp.arange(self.NUM_NODES) == i) & cond

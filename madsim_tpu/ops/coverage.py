@@ -11,14 +11,24 @@ gather + scatter per lane per step (NOT a one-hot masked select — a
 2^14-wide select per step would dwarf the step itself).
 
 Slot layout is structured, not a flat hash, so the map stays *decodable*
-on the host (runtime/coverage.py):
+on the host (runtime/coverage.py). Two banded layouts exist (the band
+width is a LAYOUT VERSION — maps carry it, old docs keep decoding):
 
+    v1 (3 band bits, the PR-4 layout — every config without the PR-5
+        chaos kinds, so historical maps and golden slots are unchanged):
     slot = [ band:3 | phase:3 | mix:(slots_log2-6) ]
 
-  * band (top 3 bits): the popped event's class — 0 timer, 1 message,
-    2..7 the fault KIND of a fault event (K_PAIR..K_DELAY). Per-band
-    slot counts are the "per-fault-kind marginal coverage" signal: which
-    chaos vocabulary is still finding new abstract states.
+    v2 (4 band bits — selected by the engine whenever pause/skew/dup/
+        strict_restart can occur, which are new configs by definition):
+    slot = [ band:4 | phase:3 | mix:(slots_log2-7) ]
+
+  * band (top bits): the popped event's class — 0 timer, 1 message,
+    2.. the fault KIND of a fault event (K_PAIR..K_SKEW). v2 adds two
+    synthetic bands with no event class of their own: `dup` (a step
+    that enqueued at least one Bernoulli duplicate) and `amnesia` (a
+    strict-restart wipe was applied). Per-band slot counts are the
+    "per-fault-kind marginal coverage" signal: which chaos vocabulary
+    is still finding new abstract states.
   * phase (next 3 bits): the low 3 bits of the model's
     `coverage_projection` word — each model puts its coarsest progress
     notion there (raft: term bucket; 2pc: txn index; see the models).
@@ -55,13 +65,22 @@ import jax.numpy as jnp
 COV_SLOTS_LOG2_DEFAULT = 14
 COV_WORD_BITS = 32  # slots per packed map word
 
-# Band index space (top 3 bits of the slot): event class, with fault
+# Band index space (top bits of the slot): event class, with fault
 # events split per FaultPlan kind. Mirrored as literals in
 # runtime/coverage.py (the host decoder never imports jax).
-COV_BAND_BITS = 3
+COV_BAND_BITS = 3       # layout v1 (PR-4): 8 bands
+COV_BAND_BITS_V2 = 4    # layout v2 (PR-5 chaos kinds): 16 bands
 COV_PHASE_BITS = 3
 COV_BANDS = 1 << COV_BAND_BITS
 COV_BAND_NAMES = ("timer", "msg", "pair", "kill", "dir", "group", "storm", "delay")
+COV_BAND_NAMES_V2 = COV_BAND_NAMES + (
+    "pause", "skew", "dup", "amnesia",
+    "reserved12", "reserved13", "reserved14", "reserved15",
+)
+# v2 synthetic bands (no popped-event class of their own; the engine
+# passes them via cov_slot's `band` override)
+COV_BAND_DUP = 10
+COV_BAND_AMNESIA = 11
 
 # mix constants: murmur3 fmix / Weyl — odd multipliers, same family as
 # core.digest_fold (any single-bit input change avalanches)
@@ -80,6 +99,16 @@ def cov_mix(words) -> jax.Array:
     return h
 
 
+def cov_band(ev_kind, op_word, band_bits: int = COV_BAND_BITS) -> jax.Array:
+    """Band index of a popped event: timer 0 / msg 1 / fault 2+kind
+    (apply and undo share a kind). EV_FAULT mirrored as a literal (2):
+    engine.core imports this module."""
+    ev_kind = jnp.asarray(ev_kind).astype(jnp.int32)
+    bands = 1 << band_bits
+    fault_kind = jnp.clip(jnp.asarray(op_word).astype(jnp.int32) // 2, 0, bands - 3)
+    return jnp.where(ev_kind == 2, 2 + fault_kind, jnp.clip(ev_kind, 0, 1))
+
+
 def cov_slot(
     abstract,
     ev_kind,
@@ -87,6 +116,8 @@ def cov_slot(
     op_word,
     fault_ctx,
     slots_log2: int,
+    band_bits: int = COV_BAND_BITS,
+    band=None,
 ) -> jax.Array:
     """Map one popped event to its slot index (int32 in [0, 2^slots_log2)).
 
@@ -94,19 +125,21 @@ def cov_slot(
     event discriminant (payload[0] for msg/fault events, 0 for timers —
     timer ids are epoch-encoded and would inflate slots per restart),
     `fault_ctx` the packed fault-environment word built by the step
-    kernel (killed count | clog/storm/spike flags).
+    kernel (killed count | clog/storm/spike flags). `band_bits` picks
+    the banded layout (3 = the PR-4 layout, the default so every
+    historical map and golden slot constant stays valid); `band`, when
+    given, overrides the event-derived band — the engine uses it for
+    the v2 synthetic bands (dup / amnesia).
     """
     ev_kind = jnp.asarray(ev_kind).astype(jnp.int32)
-    # band: timer 0 / msg 1 / fault 2+kind (apply and undo share a kind).
-    # EV_FAULT mirrored as a literal (2): engine.core imports this module.
-    fault_kind = jnp.clip(jnp.asarray(op_word).astype(jnp.int32) // 2, 0, COV_BANDS - 3)
-    band = jnp.where(ev_kind == 2, 2 + fault_kind, jnp.clip(ev_kind, 0, 1))
+    if band is None:
+        band = cov_band(ev_kind, op_word, band_bits)
     abstract = jnp.asarray(abstract).astype(jnp.uint32)
     phase = (abstract & jnp.uint32((1 << COV_PHASE_BITS) - 1)).astype(jnp.int32)
-    mix_bits = slots_log2 - COV_BAND_BITS - COV_PHASE_BITS
+    mix_bits = slots_log2 - band_bits - COV_PHASE_BITS
     h = cov_mix([abstract, ev_kind, ev_node, op_word, fault_ctx])
     mix = (h & jnp.uint32((1 << mix_bits) - 1)).astype(jnp.int32)
-    return (band << (slots_log2 - COV_BAND_BITS)) | (phase << mix_bits) | mix
+    return (band << (slots_log2 - band_bits)) | (phase << mix_bits) | mix
 
 
 def cov_fold(cov_map: jax.Array, slot, hit) -> jax.Array:

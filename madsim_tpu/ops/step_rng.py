@@ -37,10 +37,14 @@ generalization of the v2 block draw.
 
 Both versions share the same block layout (`StepRngLayout`):
 
-    [ handler H | latency M | drop M? | spike M? | spike_mag M? | restart 2? ]
+    [ handler H | latency M | drop M? | spike M? | spike_mag M? | restart 2? | dup 2M? ]
 
 v2 always materializes the drop (and, under `allow_delay`, spike)
-sections; v3 omits statically-dead sections entirely. The engine
+sections; v3 omits statically-dead sections entirely. The duplication
+section (`FaultPlan.allow_dup`, PR-5: gate word + fresh-latency word per
+message slot) is appended at the END of both layouts — existing section
+offsets never move, so every recorded stream stays byte-stable with the
+flag off. The engine
 additionally elides the *compute* that consumes a section when it is
 statically inert (e.g. loss_rate==0 and no storms ⇒ the drop compare
 always yields False) — that elision is result-preserving in both
@@ -105,6 +109,11 @@ class StepRngLayout:
     loss_active: bool
     spike_active: bool
     restart_active: bool
+    # message-duplication section (gate words; fresh-latency words follow
+    # at +max_msgs). Appended at the tail of BOTH stream versions so the
+    # flag-off block is bit-identical to the pre-dup layouts.
+    dup_off: Optional[int] = None
+    dup_active: bool = False
 
 
 def layout_for(
@@ -116,12 +125,16 @@ def layout_for(
     spike_possible: bool,
     delay_enabled: bool,
     restart_possible: bool,
+    dup_possible: bool = False,
 ) -> StepRngLayout:
     """Build the block layout. `delay_enabled` is the raw
     `FaultPlan.allow_delay` flag (v2 materializes spike words on it
-    alone); `spike_possible` additionally requires n_faults > 0."""
+    alone); `spike_possible` additionally requires n_faults > 0.
+    `dup_possible` (`FaultPlan.allow_dup`) appends the duplication
+    section to the tail of either version — never moves an offset."""
     h, m = handler_words, max_msgs
     if version == RNG_STREAM_LEGACY:
+        legacy_total = h + (4 if delay_enabled else 2) * m
         return StepRngLayout(
             version=version,
             handler_words=h,
@@ -130,10 +143,12 @@ def layout_for(
             drop_off=h + m,
             spike_off=h + 2 * m if delay_enabled else None,
             restart_off=None,
-            total_words=h + (4 if delay_enabled else 2) * m,
+            total_words=legacy_total + (2 * m if dup_possible else 0),
             loss_active=loss_possible,
             spike_active=delay_enabled and spike_possible,
             restart_active=restart_possible,
+            dup_off=legacy_total if dup_possible else None,
+            dup_active=dup_possible,
         )
     if version != RNG_STREAM_COUNTER:
         raise ValueError(f"unknown rng_stream version {version!r}")
@@ -150,6 +165,10 @@ def layout_for(
     if restart_possible:
         restart_off = cursor
         cursor += 2
+    dup_off = None
+    if dup_possible:
+        dup_off = cursor
+        cursor += 2 * m
     return StepRngLayout(
         version=version,
         handler_words=h,
@@ -162,6 +181,8 @@ def layout_for(
         loss_active=loss_possible,
         spike_active=spike_possible,
         restart_active=restart_possible,
+        dup_off=dup_off,
+        dup_active=dup_possible,
     )
 
 

@@ -1,0 +1,90 @@
+"""Hunt/explore checkpointing — resume an interrupted streaming run exactly.
+
+`hunt --checkpoint PATH` persists per-batch progress from the chunked
+streaming driver (`__main__._stream_batches`): the seed cursor, the
+completed/failing/infra/abandoned aggregates, the cumulative coverage
+map and the plateau-detector state. A process killed between batches
+resumes from the last completed batch ("resumed at batch k/n") and the
+final report is bit-identical to the uninterrupted run — batch i always
+consumes the same seed range, so the only state that matters is the
+cursor and the aggregates, both of which are recorded atomically
+(tmp + rename) after every batch.
+
+The checkpoint carries a FINGERPRINT of every argument that shapes the
+seed schedule or the failure semantics; resuming with a mismatched
+command line is refused rather than silently blending two different
+hunts. Pure host-side JSON — no jax import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+CKPT_VERSION = 1
+
+# args fields that must match for a resume to be sound: anything that
+# changes which seeds run, in what order, or what they mean.
+_FINGERPRINT_FIELDS = (
+    "machine",
+    "nodes",
+    "seed",
+    "seeds",
+    "batch",
+    "max_steps",
+    "horizon",
+    "loss",
+    "faults",
+    "fault_tmax",
+    "fault_kinds",
+    "rng_stream",
+    "strict_restart",
+    "coverage",
+    "stop_on_plateau",
+)
+
+
+def fingerprint_from_args(args) -> dict:
+    return {f: getattr(args, f, None) for f in _FINGERPRINT_FIELDS}
+
+
+def save_checkpoint(path: str, state: dict) -> None:
+    """Atomic write (tmp + rename): a kill mid-write leaves the previous
+    checkpoint intact, never a truncated JSON."""
+    doc = {"version": CKPT_VERSION, **state}
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> Optional[dict]:
+    """Load a checkpoint, or None when the file doesn't exist (a fresh
+    run). A malformed or wrong-version file raises — silently starting
+    over would throw away a long hunt's progress."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("version") != CKPT_VERSION:
+        raise ValueError(
+            f"{path}: checkpoint version {doc.get('version')!r}, "
+            f"expected {CKPT_VERSION}"
+        )
+    return doc
+
+
+def check_fingerprint(ckpt: dict, args) -> Optional[str]:
+    """None when the checkpoint belongs to this command line; otherwise
+    a human-readable description of the first mismatch."""
+    want = fingerprint_from_args(args)
+    got = ckpt.get("fingerprint", {})
+    for field in _FINGERPRINT_FIELDS:
+        if got.get(field) != want.get(field):
+            return (
+                f"checkpoint was recorded with {field}="
+                f"{got.get(field)!r}, this run has {want.get(field)!r}"
+            )
+    return None

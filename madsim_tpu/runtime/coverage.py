@@ -18,9 +18,17 @@ stats endpoint must work on boxes with no accelerator stack warm):
     `hunt --coverage-out` artifact (base64 maps keyed by machine) and
     cross-run diffing.
 
-Slot layout (mirrors ops/coverage.py as literals — keep in sync):
+Slot layout (mirrors ops/coverage.py as literals — keep in sync). Two
+banded layout versions exist; maps and docs carry `band_bits` so every
+historical 3-bit doc keeps rendering:
 
-    slot = [ band:3 | phase:3 | mix:(slots_log2-6) ]
+    v1 (band_bits=3, PR-4):  slot = [ band:3 | phase:3 | mix:(slots_log2-6) ]
+    v2 (band_bits=4, PR-5):  slot = [ band:4 | phase:3 | mix:(slots_log2-7) ]
+
+v2 is selected by the engine whenever a PR-5 chaos capability
+(pause/skew/dup/strict_restart) can occur; it adds the pause/skew fault
+bands plus the synthetic dup (a step that enqueued a Bernoulli
+duplicate) and amnesia (a strict-restart wipe) bands.
 """
 
 from __future__ import annotations
@@ -35,10 +43,24 @@ COV_BAND_BITS = 3
 COV_PHASE_BITS = 3
 COV_BANDS = 1 << COV_BAND_BITS
 COV_PHASES = 1 << COV_PHASE_BITS
-# band 0/1: event class; 2..7: fault kind (mirrors core.FAULT_KIND_NAMES)
+# band 0/1: event class; 2..: fault kind (mirrors core.FAULT_KIND_NAMES)
 COV_BAND_NAMES = ("timer", "msg", "pair", "kill", "dir", "group", "storm", "delay")
+COV_BAND_NAMES_V2 = COV_BAND_NAMES + (
+    "pause", "skew", "dup", "amnesia",
+    "reserved12", "reserved13", "reserved14", "reserved15",
+)
 
-COV_DOC_VERSION = 1
+# doc v1: band_bits implicitly 3; v2 carries an explicit band_bits field
+COV_DOC_VERSION = 2
+_ACCEPTED_DOC_VERSIONS = (1, 2)
+
+
+def band_names(band_bits: int = COV_BAND_BITS) -> tuple:
+    if band_bits == 3:
+        return COV_BAND_NAMES
+    if band_bits == 4:
+        return COV_BAND_NAMES_V2
+    raise ValueError(f"unknown coverage band layout: band_bits={band_bits}")
 
 
 def _as_bool_map(map_arr) -> np.ndarray:
@@ -60,7 +82,7 @@ def unpack_map(words, slots_log2: int) -> np.ndarray:
     return bits.reshape(*w.shape[:-1], 1 << slots_log2).astype(bool)
 
 
-def coverage_dict(map_arr, slots_log2: int) -> dict:
+def coverage_dict(map_arr, slots_log2: int, band_bits: int = COV_BAND_BITS) -> dict:
     """Summarize a global coverage vector: total slots hit, fraction,
     and the per-band marginals (how much of each event class / fault
     kind's slot space has been reached)."""
@@ -68,39 +90,46 @@ def coverage_dict(map_arr, slots_log2: int) -> dict:
     total = 1 << slots_log2
     if m.size != total:
         raise ValueError(f"map has {m.size} slots, expected {total}")
-    per_band = m.reshape(COV_BANDS, -1).sum(axis=1)
+    per_band = m.reshape(1 << band_bits, -1).sum(axis=1)
     hit = int(m.sum())
     return {
         "slots_hit": hit,
         "slots_total": total,
         "fraction": round(hit / total, 6),
         "by_band": {
-            name: int(n) for name, n in zip(COV_BAND_NAMES, per_band)
+            name: int(n) for name, n in zip(band_names(band_bits), per_band)
         },
     }
 
 
-def cell_table(map_arr, slots_log2: int) -> np.ndarray:
-    """[COV_BANDS, COV_PHASES] hit counts — the fault/event-class x
-    model-phase cell grid. Each cell owns 2^(slots_log2-6) mix slots."""
+def cell_table(map_arr, slots_log2: int, band_bits: int = COV_BAND_BITS) -> np.ndarray:
+    """[bands, COV_PHASES] hit counts — the fault/event-class x
+    model-phase cell grid. Each cell owns
+    2^(slots_log2-band_bits-3) mix slots."""
     m = _as_bool_map(map_arr)
-    return m.reshape(COV_BANDS, COV_PHASES, -1).sum(axis=2)
+    return m.reshape(1 << band_bits, COV_PHASES, -1).sum(axis=2)
 
 
-def top_uncovered(map_arr, slots_log2: int, top: int = 8) -> list:
+def top_uncovered(
+    map_arr, slots_log2: int, top: int = 8, band_bits: int = COV_BAND_BITS
+) -> list:
     """The `top` least-covered (band, phase) cells that have been
     TOUCHED at least once, plus every never-touched cell, ranked
     emptiest-first. A touched-but-thin cell is a reachable scenario
     class the hunt has barely explored — the steering signal a
-    coverage-guided search would consume."""
-    cells = cell_table(map_arr, slots_log2)
-    cell_size = 1 << (slots_log2 - COV_BAND_BITS - COV_PHASE_BITS)
+    coverage-guided search would consume. Reserved v2 bands are
+    skipped (nothing can ever land there)."""
+    cells = cell_table(map_arr, slots_log2, band_bits=band_bits)
+    cell_size = 1 << (slots_log2 - band_bits - COV_PHASE_BITS)
+    names = band_names(band_bits)
     out = []
-    for b in range(COV_BANDS):
+    for b in range(1 << band_bits):
+        if names[b].startswith("reserved"):
+            continue
         for p in range(COV_PHASES):
             out.append(
                 {
-                    "band": COV_BAND_NAMES[b],
+                    "band": names[b],
                     "phase": p,
                     "hit": int(cells[b, p]),
                     "fraction": round(int(cells[b, p]) / cell_size, 4),
@@ -157,21 +186,29 @@ def make_coverage_doc(
     maps: Dict[str, np.ndarray],
     slots_log2: int,
     meta: Optional[dict] = None,
+    band_bits: int = COV_BAND_BITS,
 ) -> dict:
     """Build the JSON document `hunt --coverage-out` writes: one map per
-    machine name (the per-model breakdown the report renders)."""
-    return {
-        "version": COV_DOC_VERSION,
+    machine name (the per-model breakdown the report renders). 3-band-bit
+    maps are written as version-1 docs (byte-compatible with every
+    pre-existing consumer); the 4-bit layout bumps the doc version and
+    records band_bits explicitly."""
+    version = 1 if band_bits == COV_BAND_BITS else COV_DOC_VERSION
+    doc = {
+        "version": version,
         "slots_log2": slots_log2,
         "meta": dict(meta or {}),
         "maps": {
             name: {
                 "map_b64": encode_map(m),
-                **coverage_dict(m, slots_log2),
+                **coverage_dict(m, slots_log2, band_bits=band_bits),
             }
             for name, m in sorted(maps.items())
         },
     }
+    if version != 1:
+        doc["band_bits"] = band_bits
+    return doc
 
 
 def save_coverage_doc(path: str, doc: dict) -> None:
@@ -183,12 +220,18 @@ def save_coverage_doc(path: str, doc: dict) -> None:
 def load_coverage_doc(path: str) -> dict:
     with open(path) as f:
         doc = json.load(f)
-    if doc.get("version") != COV_DOC_VERSION:
+    if doc.get("version") not in _ACCEPTED_DOC_VERSIONS:
         raise ValueError(
             f"{path}: coverage doc version {doc.get('version')!r}, "
-            f"expected {COV_DOC_VERSION}"
+            f"expected one of {_ACCEPTED_DOC_VERSIONS}"
         )
     return doc
+
+
+def doc_band_bits(doc: dict) -> int:
+    """The banded layout a doc was written under (v1 docs predate the
+    field and are always 3-bit)."""
+    return int(doc.get("band_bits", COV_BAND_BITS))
 
 
 def doc_maps(doc: dict) -> Dict[str, np.ndarray]:
@@ -213,19 +256,20 @@ def diff_maps(a: np.ndarray, b: np.ndarray) -> dict:
 def render_report(doc: dict, top: int = 8, diff_doc: Optional[dict] = None) -> str:
     """Human-readable coverage report for one (optionally two) docs."""
     L = doc["slots_log2"]
+    bb = doc_band_bits(doc)
     lines = []
     other = doc_maps(diff_doc) if diff_doc is not None else {}
     for name, m in doc_maps(doc).items():
-        d = coverage_dict(m, L)
+        d = coverage_dict(m, L, band_bits=bb)
         lines.append(
             f"{name}: {d['slots_hit']}/{d['slots_total']} slots "
             f"({100 * d['fraction']:.2f}%)"
         )
-        band_bits = ", ".join(
+        band_txt = ", ".join(
             f"{k}={v}" for k, v in d["by_band"].items() if v
         )
-        lines.append(f"  by band: {band_bits or 'none'}")
-        cells = top_uncovered(m, L, top=top)
+        lines.append(f"  by band: {band_txt or 'none'}")
+        cells = top_uncovered(m, L, top=top, band_bits=bb)
         worst = ", ".join(
             f"{c['band']}x{c['phase']}={c['hit']}" for c in cells
         )

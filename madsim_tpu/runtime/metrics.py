@@ -15,24 +15,31 @@ from typing import TYPE_CHECKING, Dict, Sequence
 if TYPE_CHECKING:
     from ..task.executor import Executor
 
-# Mirrors engine/core.py's FAULT_KIND_NAMES / FR_METRICS_LEN (kept as
-# literals here so this host-side module never imports jax).
-FR_FAULT_KINDS = ("pair", "kill", "dir", "group", "storm", "delay")
+# Mirrors engine/core.py's FAULT_KIND_NAMES / FR_EXTRA_NAMES /
+# FR_METRICS_LEN (kept as literals here so this host-side module never
+# imports jax).
+FR_FAULT_KINDS = (
+    "pair", "kill", "dir", "group", "storm", "delay", "pause", "skew"
+)
+FR_EXTRAS = ("dup", "amnesia")
 
 
 def fr_metrics_dict(vec: Sequence[int]) -> Dict[str, object]:
-    """Decode a flight-recorder metrics vector: 6 per-kind fault
-    injection totals, then queue / clogged-link / killed-node high-water
-    marks."""
+    """Decode a flight-recorder metrics vector: per-kind fault injection
+    totals, the non-scheduled chaos counters (message duplicates pushed,
+    crash-with-amnesia restarts applied), then queue / clogged-link /
+    killed-node high-water marks."""
     v = [int(x) for x in vec]
-    nk = len(FR_FAULT_KINDS)
-    if len(v) != nk + 3:
-        raise ValueError(f"expected {nk + 3} metric words, got {len(v)}")
+    nk, ne = len(FR_FAULT_KINDS), len(FR_EXTRAS)
+    if len(v) != nk + ne + 3:
+        raise ValueError(f"expected {nk + ne + 3} metric words, got {len(v)}")
     return {
         "faults_injected": dict(zip(FR_FAULT_KINDS, v[:nk])),
-        "queue_hwm": v[nk],
-        "clog_links_hwm": v[nk + 1],
-        "killed_hwm": v[nk + 2],
+        "dup_injected": v[nk],
+        "amnesia_restarts": v[nk + 1],
+        "queue_hwm": v[nk + ne],
+        "clog_links_hwm": v[nk + ne + 1],
+        "killed_hwm": v[nk + ne + 2],
     }
 
 

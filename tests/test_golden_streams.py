@@ -125,6 +125,71 @@ V3_WORDS = {
 }
 
 
+# v3 counter stream WITH the PR-5 duplication section: same (4, 4,
+# no-delay, kill) config plus allow_dup => 18-word block
+# [handler 4 | lat 4 | restart 2 | dup 8]. W changes, so this is a NEW
+# pinned stream (counter = step*18 + iota); the dup-OFF block above is
+# untouched — that is the byte-stability contract.
+V3_DUP_WORDS = {
+    7: [
+        [651372970, 1641003165, 4259759113, 830191501, 2543082826, 1701606646,
+         1850397451, 383445794, 1466414099, 558659640, 2668535539, 2285691388,
+         720074552, 4243045693, 1742119742, 4243794367, 2215412076, 155270363],
+        [1777434092, 644396529, 3913584264, 469921086, 3716644114, 2027927174,
+         4258361963, 3767944336, 736985225, 2140010, 3143326239, 3257841404,
+         2379367988, 4092191589, 4100656410, 3831774530, 914001907, 2578195557],
+    ],
+    123: [
+        [1061889091, 2343006490, 3997153370, 3747912777, 2645534252, 3709234104,
+         2208487181, 1968141284, 3608368773, 3262677698, 2978737244, 3737086252,
+         3332214997, 3984418987, 3686978842, 325655645, 258537910, 848770202],
+        [1345064064, 818209895, 3795277425, 1191277824, 3307115550, 1697939720,
+         2348577852, 3986674684, 1162353679, 3478757770, 2153672204, 713638025,
+         3377012704, 2482713552, 2442345633, 3869989311, 2766960863, 2487333485],
+    ],
+}
+
+# v2 + dup, step 0, seed 7: the first 12 words must BE V2_WORDS[7][0]
+# (the dup section rides the tail; jax.random.bits extends the counter,
+# so the legacy prefix is untouched) — pinned tail words follow.
+V2_DUP_TAIL_7 = [1537568898, 988553731, 2699239489, 3125584811,
+                 2504740702, 1895120738, 2569829754, 4011237394]
+
+# Window-kind (pause/skew) fault schedules. The extra per-fault draw
+# (the skew q10 factor) shifts the k_faults chain, so schedules with
+# window kinds enabled are a NEW pinned derivation; V1_SCHED/V2_SCHED
+# above must keep passing untouched — that is the off-bit-stability
+# proof. PAUSE rows pin arg2 = resume time (t + dur); SKEW rows pin
+# arg2 = the drawn q10 factor.
+WINDOW_FAULTS = dataclasses.replace(
+    V2_FAULTS, allow_pause=True, allow_skew=True
+)
+WINDOW_SCHED = {
+    7: {
+        "time": [2359908, 2901252, 1011953, 1349725],
+        "seq": [5, 6, 7, 8],
+        "node": [2, 2, 0, 0],
+        "pay": [[8, 52428, 0, 0, 0, 0], [9, 52428, 0, 0, 0, 0],
+                [2, 0, 3, 0, 0, 0], [3, 0, 3, 0, 0, 0]],
+    },
+    123: {
+        "time": [2025571, 2552840, 1046676, 1496377],
+        "seq": [5, 6, 7, 8],
+        "node": [1, 1, 3, 3],
+        "pay": [[2, 1, 2, 0, 0, 0], [3, 1, 2, 0, 0, 0],
+                [2, 3, 2, 0, 0, 0], [3, 3, 2, 0, 0, 0]],
+    },
+}
+PAUSE_ONLY_ROWS_7 = {
+    "time": [359908, 701252], "node": [2, 2],
+    "pay": [[12, 2, 701252, 0, 0, 0], [13, 2, 701252, 0, 0, 0]],
+}
+SKEW_ONLY_ROWS_7 = {
+    "time": [359908, 701252], "node": [2, 2],
+    "pay": [[14, 2, 680, 0, 0, 0], [15, 2, 680, 0, 0, 0]],
+}
+
+
 def _lane_key(seed):
     key = jax.random.PRNGKey(seed)
     key, _k_init, _k_faults = jax.random.split(key, 3)
@@ -196,6 +261,97 @@ def test_fault_schedules_pinned(faults, sched, rng_stream):
         assert s.eq_node[rows].tolist() == expect["node"], seed
         assert s.eq_payload[rows].tolist() == expect["pay"], seed
         assert bool(s.eq_valid[rows].all())
+
+
+def test_dup_section_rides_the_tail():
+    """The duplication section appends to BOTH layouts without moving an
+    existing offset — the off-bit-stability proof at the layout level."""
+    base3, dup3 = _v3_layout(), layout_for(
+        RNG_STREAM_COUNTER, 4, 4, loss_possible=False, spike_possible=False,
+        delay_enabled=False, restart_possible=True, dup_possible=True,
+    )
+    assert (dup3.lat_off, dup3.restart_off) == (base3.lat_off, base3.restart_off)
+    assert dup3.dup_off == base3.total_words == 10
+    assert dup3.total_words == 18
+    base2, dup2 = _v2_layout(), layout_for(
+        RNG_STREAM_LEGACY, 4, 4, loss_possible=False, spike_possible=False,
+        delay_enabled=False, restart_possible=True, dup_possible=True,
+    )
+    assert (dup2.lat_off, dup2.drop_off) == (base2.lat_off, base2.drop_off)
+    assert dup2.dup_off == base2.total_words == 12
+    assert dup2.total_words == 20
+
+
+def test_v3_dup_step_words_pinned():
+    layout = layout_for(
+        RNG_STREAM_COUNTER, 4, 4, loss_possible=False, spike_possible=False,
+        delay_enabled=False, restart_possible=True, dup_possible=True,
+    )
+    for seed, expect in V3_DUP_WORDS.items():
+        key = _lane_key(seed)
+        for step in range(2):
+            _k, words, k_restart = step_words_v3(key, jnp.int32(step), layout)
+            assert words.tolist() == expect[step], (seed, step)
+            # restart key still reads from offset 8 — dup is pure tail
+            assert k_restart.tolist() == words[8:10].tolist()
+
+
+def test_v2_dup_prefix_is_the_legacy_stream():
+    """v2 + dup: the first 12 words of the 20-word block are bit-exactly
+    the pinned legacy block (same key chain, counter extended), and the
+    restart key is untouched — recorded v2 seeds cannot notice the dup
+    section existing."""
+    layout = layout_for(
+        RNG_STREAM_LEGACY, 4, 4, loss_possible=False, spike_possible=False,
+        delay_enabled=False, restart_possible=True, dup_possible=True,
+    )
+    key = _lane_key(7)
+    _k, words, k_restart = step_words(key, jnp.int32(0), layout)
+    assert words.tolist()[:12] == V2_WORDS[7][0]
+    assert words.tolist()[12:] == V2_DUP_TAIL_7
+    assert k_restart.tolist() == V2_K_RESTART[7][0]
+
+
+def test_window_kind_fault_schedules_pinned():
+    """The pause/skew derivation (one extra per-fault draw) is pinned:
+    the mixed-vocabulary schedule, plus pause-only rows (arg2 = resume
+    time) and skew-only rows (arg2 = q10 factor). V1_SCHED/V2_SCHED
+    passing above is the proof the extra draw is invisible with the
+    window kinds off."""
+    eng = Engine(
+        RaftMachine(num_nodes=5, log_capacity=8),
+        EngineConfig(
+            horizon_us=5_000_000, queue_capacity=32, faults=WINDOW_FAULTS
+        ),
+    )
+    for seed, expect in WINDOW_SCHED.items():
+        s = eng.init_lane(seed)
+        rows = slice(5, 9)
+        assert s.eq_time[rows].tolist() == expect["time"], seed
+        assert s.eq_seq[rows].tolist() == expect["seq"], seed
+        assert s.eq_node[rows].tolist() == expect["node"], seed
+        assert s.eq_payload[rows].tolist() == expect["pay"], seed
+    window = dict(
+        n_faults=1, allow_partition=False, allow_kill=False,
+        t_min_us=200_000, t_max_us=600_000,
+        dur_min_us=200_000, dur_max_us=400_000,
+    )
+    for kind_flags, expect in (
+        (dict(allow_pause=True), PAUSE_ONLY_ROWS_7),
+        (dict(allow_skew=True), SKEW_ONLY_ROWS_7),
+    ):
+        eng = Engine(
+            RaftMachine(num_nodes=5, log_capacity=8),
+            EngineConfig(
+                horizon_us=2_000_000, queue_capacity=32,
+                faults=FaultPlan(**window, **kind_flags),
+            ),
+        )
+        s = eng.init_lane(7)
+        rows = slice(5, 7)
+        assert s.eq_time[rows].tolist() == expect["time"], kind_flags
+        assert s.eq_node[rows].tolist() == expect["node"], kind_flags
+        assert s.eq_payload[rows].tolist() == expect["pay"], kind_flags
 
 
 def test_engine_v2_block_matches_module():

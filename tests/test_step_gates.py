@@ -198,6 +198,89 @@ def test_clog_packed_rejects_oversized_machines():
         Engine(m, EngineConfig(queue_capacity=256, faults=FaultPlan(n_faults=0)))
 
 
+def test_strict_restart_gate_bit_identical():
+    """Crash-with-amnesia for a machine whose durable_spec matches its
+    hand-written restart hook (every honest shipped model): strict
+    on/off must be bit-identical under kill/restart chaos — the generic
+    wipe IS the model's own semantics, just contract-driven. (The
+    divergence case — a model whose spec lies — is the bug detector,
+    exercised in tests/test_chaos_palette.py.)"""
+    r_off = _run(Engine(_machine(), BENCH_LIKE))
+    r_on = _run(
+        Engine(
+            _machine(),
+            dataclasses.replace(
+                BENCH_LIKE,
+                faults=dataclasses.replace(
+                    BENCH_LIKE.faults, strict_restart=True
+                ),
+            ),
+        )
+    )
+    _assert_results_equal(r_off, r_on)
+
+
+def test_new_chaos_kinds_live_and_observable():
+    """The whole PR-5 palette on at once (pause + skew + dup +
+    strict_restart, on top of FULL_CHAOS) with recorder + coverage:
+    every new capability must show nonzero injection counters AND
+    nonzero coverage in its own 4-bit-layout band — the 'is this chaos
+    actually reachable' assertion. One engine covers all four (tier-1
+    compile budget)."""
+    import numpy as np
+
+    from madsim_tpu.engine.core import K_PAUSE, K_SKEW
+    from madsim_tpu.runtime.coverage import coverage_dict, unpack_map
+
+    cfg = dataclasses.replace(
+        FULL_CHAOS,
+        rng_stream=3,
+        flight_recorder=True,
+        fr_digest_every=64,
+        fr_digest_ring=4,
+        coverage=True,
+        cov_slots_log2=12,
+        faults=dataclasses.replace(
+            FULL_CHAOS.faults,
+            allow_pause=True,
+            allow_skew=True,
+            allow_dup=True,
+            strict_restart=True,
+        ),
+    )
+    eng = Engine(_machine(), cfg)
+    assert eng.cov_band_bits == 4
+    res = _run(eng, n=48, max_steps=1200)
+    inj = res.fr["inj"].sum(axis=0).tolist()
+    assert inj[K_PAUSE] > 0 and inj[K_SKEW] > 0, inj
+    assert int(res.fr["dup"].sum()) > 0
+    assert int(res.fr["amnesia"].sum()) > 0
+    m = unpack_map(
+        np.bitwise_or.reduce(np.asarray(res.cov["map"]), axis=0), 12
+    )
+    bands = coverage_dict(m, 12, band_bits=4)["by_band"]
+    for band in ("pause", "skew", "dup", "amnesia"):
+        assert bands[band] > 0, (band, bands)
+
+
+def test_coverage_band4_needs_one_more_slot_bit():
+    """The 4-bit banded layout (any PR-5 capability on) steals one mix
+    bit, so the minimum map size rises from 2^7 to 2^8."""
+    faults = dataclasses.replace(BENCH_LIKE.faults, allow_dup=True)
+    with pytest.raises(ValueError, match="cov_slots_log2"):
+        Engine(
+            _machine(),
+            dataclasses.replace(
+                BENCH_LIKE, coverage=True, cov_slots_log2=7, faults=faults
+            ),
+        )
+    # 2^7 stays legal for the legacy 3-bit layout
+    Engine(
+        _machine(),
+        dataclasses.replace(BENCH_LIKE, coverage=True, cov_slots_log2=7),
+    )
+
+
 def test_compile_cache_wiring(tmp_path, monkeypatch):
     """Engine(config.compile_cache_dir) enables the persistent cache and
     compiles land in the directory. Process-global and first-dir-wins,
