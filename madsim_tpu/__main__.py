@@ -39,6 +39,7 @@ def build_machine(name: str, nodes: int = 0):
     from .models.multipaxos import MultiPaxosMachine, NoPromiseCheckMultiPaxos
     from .models.paxos import NoPromiseCheckPaxos, PaxosMachine
     from .models.raft import RaftMachine
+    from .models.raft_compact import RaftCompactMachine, TornSnapshotRaftCompact
     from .models.s3 import S3Machine
     from .models.twopc import TwoPcMachine
 
@@ -111,6 +112,12 @@ def build_machine(name: str, nodes: int = 0):
         "demo-dupvote-raft": lambda: DupVoteRaft(
             num_nodes=nodes or 5, log_capacity=8
         ),
+        "raft-compact": lambda: RaftCompactMachine(
+            num_nodes=nodes or 5, log_capacity=8
+        ),
+        "demo-tornsnapshot-raft": lambda: TornSnapshotRaftCompact(
+            num_nodes=nodes or 5, log_capacity=8
+        ),
         "demo-nodedup-mvcc": lambda: NoDedupMvcc(num_nodes=nodes or 4),
         "demo-giveup-mvcc": lambda: PrematureGiveupMvcc(num_nodes=nodes or 4),
         "demo-nopromise-multipaxos": lambda: NoPromiseCheckMultiPaxos(
@@ -165,7 +172,7 @@ def _fault_kind_flags(args) -> dict:
     kinds = {k.strip() for k in raw.split(",") if k.strip()}
     known = {
         "pair", "kill", "dir", "group", "storm", "delay",
-        "pause", "skew", "dup",
+        "pause", "skew", "dup", "torn", "heal-asym",
     }
     if not kinds <= known:
         sys.exit(f"unknown fault kinds {sorted(kinds - known)}; choose from {sorted(known)}")
@@ -185,6 +192,8 @@ def _fault_kind_flags(args) -> dict:
         "allow_pause": "pause" in kinds,
         "allow_skew": "skew" in kinds,
         "allow_dup": "dup" in kinds,
+        "allow_torn": "torn" in kinds,
+        "allow_heal_asym": "heal-asym" in kinds,
     }
 
 
@@ -197,7 +206,8 @@ def fault_kinds_str(fp) -> str:
         ("dir", fp.allow_dir_clog), ("group", fp.allow_group),
         ("storm", fp.allow_storm), ("delay", fp.allow_delay),
         ("pause", fp.allow_pause), ("skew", fp.allow_skew),
-        ("dup", fp.allow_dup),
+        ("dup", fp.allow_dup), ("torn", fp.allow_torn),
+        ("heal-asym", fp.allow_heal_asym),
     )
     return ",".join(name for name, on in pairs if on) or "pair"
 
@@ -1227,10 +1237,12 @@ def main(argv=None) -> int:
         p.add_argument(
             "--fault-kinds", default="pair,kill",
             help="comma list of fault kinds to draw from: "
-            "pair,kill,dir,group,storm,delay,pause,skew,dup (default "
-            "pair,kill; any other kind switches to the v2 schedule "
-            "derivation; dup is per-delivery Bernoulli duplication, not "
-            "a scheduled window)",
+            "pair,kill,dir,group,storm,delay,pause,skew,dup,torn,"
+            "heal-asym (default pair,kill; any other kind switches to "
+            "the v2 schedule derivation; dup is per-delivery Bernoulli "
+            "duplication, not a scheduled window; torn restarts damage "
+            "durable state per Machine.torn_spec(); heal-asym "
+            "partitions heal one direction at a time)",
         )
         p.add_argument(
             "--strict-restart", action="store_true",

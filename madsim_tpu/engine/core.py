@@ -83,6 +83,15 @@ F_RESUME = 13  # targeting it DEFER past resume, not drop) — the device
 #                analogue of Handle::pause (reference runtime/mod.rs)
 F_SKEW = 14      # clock-skew window: payload[2] is a q10 multiplier —
 F_SKEW_END = 15  # the node's timer delays are stretched/compressed
+F_TORN = 16          # torn/lost-write fault: kill node a; payload[2] is the
+F_TORN_RESTART = 17  # schedule-drawn damage mask — the restart wipes
+#                      volatile leaves AND damages durable leaves per the
+#                      machine's torn_spec() atomicity contract ("the
+#                      disk lied" — the FoundationDB buggify class)
+F_HASYM = 18       # asymmetric partition: clog pair a<->b both ways; the
+F_HASYM_HEAL = 19  # heal op unclogs ONE direction arg1->arg2 — the two
+#                    directions heal at independently drawn times, so
+#                    every partition tail is a one-way-link window
 
 # FaultPlan kind indices (op_apply = 2*kind)
 K_PAIR = 0
@@ -93,6 +102,8 @@ K_STORM = 4
 K_DELAY = 5
 K_PAUSE = 6
 K_SKEW = 7
+K_TORN = 8
+K_HEAL_ASYM = 9
 
 # delay-spike parameters — the host fabric's buggify numbers
 # (net/__init__.py rand_delay: 10% of sends suspended 1-5 s)
@@ -144,7 +155,10 @@ _DIGEST_M1 = 0x85EBCA6B
 
 # FaultPlan kind names, indexed by K_* — the fault-injection counter
 # labels used by run_stream stats / bench / audit output.
-FAULT_KIND_NAMES = ("pair", "kill", "dir", "group", "storm", "delay", "pause", "skew")
+FAULT_KIND_NAMES = (
+    "pair", "kill", "dir", "group", "storm", "delay", "pause", "skew",
+    "torn", "heal-asym",
+)
 
 # Non-scheduled chaos injection counters (flight recorder): Bernoulli
 # message duplicates pushed, and strict (crash-with-amnesia) restarts
@@ -229,6 +243,22 @@ class FaultPlan:
         the node arms is stretched/compressed by a q10 factor drawn in
         [0.5x, 2.0x) (payload[2]); leases expire late, heartbeats fire
         early, election timeouts drift.
+      * torn: a torn/lost-write storage fault — kill a random node,
+        then restart it through the machine's `torn_spec()` atomicity
+        contract instead of its restart hook: volatile leaves wipe
+        (amnesia), and durable leaves marked non-atomically-written
+        (TORN_LOSE / TORN_PREFIX) keep only a seeded prefix or revert
+        entirely, per a damage word drawn in the schedule (payload[2])
+        and salted by the step's torn RNG word. "The disk lied" — the
+        FoundationDB buggify finding class. A machine with only a
+        `durable_spec()` survives by construction (default spec: every
+        durable write is atomic).
+      * heal_asym: an asymmetric partition — clog a random pair both
+        ways, then heal the two directions at INDEPENDENTLY drawn
+        times (a->b at t+dur, b->a at t+dur2), so every partition tail
+        is a one-way-link window: acks flow without requests, requests
+        without acks. Each fault takes a third schedule slot for the
+        second heal (only materialized when the kind is enabled).
 
     Plus two non-scheduled chaos gates:
       * `allow_dup`: Bernoulli per-delivery message duplication — each
@@ -262,6 +292,8 @@ class FaultPlan:
     allow_pause: bool = False  # pause/resume windows (freeze, defer deliveries)
     allow_skew: bool = False   # per-node clock-skew windows (q10 timer scale)
     allow_dup: bool = False    # Bernoulli per-delivery message duplication
+    allow_torn: bool = False   # torn/lost-write faults via Machine.torn_spec()
+    allow_heal_asym: bool = False  # asymmetric partition healing (one-way decay)
     strict_restart: bool = False  # crash-with-amnesia via Machine.durable_spec()
     storm_loss_u16: int = 52428  # ~80% loss while a storm is active
     t_min_us: int = 0
@@ -287,6 +319,10 @@ class FaultPlan:
             kinds.append(K_PAUSE)
         if self.allow_skew:
             kinds.append(K_SKEW)
+        if self.allow_torn:
+            kinds.append(K_TORN)
+        if self.allow_heal_asym:
+            kinds.append(K_HEAL_ASYM)
         return tuple(kinds)
 
     @property
@@ -294,6 +330,7 @@ class FaultPlan:
         return (
             self.allow_dir_clog or self.allow_group or self.allow_storm
             or self.allow_delay or self.uses_window_kinds
+            or self.uses_storage_kinds
         )
 
     @property
@@ -302,6 +339,23 @@ class FaultPlan:
         factor) to each fault's v2 derivation — kept behind this flag so
         dir/group/storm/delay-era schedules replay byte-identically."""
         return self.allow_pause or self.allow_skew
+
+    @property
+    def uses_storage_kinds(self) -> bool:
+        """The PR-6 scheduled kinds (torn / heal_asym): one more
+        per-fault draw — the torn damage mask, doubling as the second
+        heal duration — taken only when either flag is on, so every
+        window-kind-era schedule replays byte-identically."""
+        return self.allow_torn or self.allow_heal_asym
+
+    @property
+    def slots_per_fault(self) -> int:
+        """Event-queue slots each fault occupies. Asymmetric healing
+        needs a third slot (the second direction's heal); it is drawn
+        for every fault when the kind is enabled and left INVALID for
+        non-heal_asym kinds, so it never perturbs them (an invalid slot
+        is ordinary free queue space)."""
+        return 3 if self.allow_heal_asym else 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -478,7 +532,7 @@ class Engine:
         else:
             self._pallas_interpret = False
         n, q = machine.NUM_NODES, config.queue_capacity
-        min_slots = n + 2 * config.faults.n_faults
+        min_slots = n + config.faults.slots_per_fault * config.faults.n_faults
         if q < min_slots + machine.MAX_MSGS + machine.MAX_TIMERS:
             raise ValueError(
                 f"queue_capacity={q} too small for {n} nodes + "
@@ -518,6 +572,28 @@ class Engine:
                 f"{type(machine).__name__}.durable_spec() to declare the "
                 f"durable-state contract (which leaves survive restart)"
             )
+        if fp.allow_torn:
+            spec = machine.durable_spec()
+            if spec is None:
+                raise ValueError(
+                    f"allow_torn (torn/lost-write storage faults) needs "
+                    f"{type(machine).__name__}.durable_spec() to declare "
+                    f"the durable-state contract the torn restart damages"
+                )
+            tspec = machine.torn_spec()
+            if tspec is not None:
+                from .machine import TORN_ATOMIC, TORN_LOSE, TORN_PREFIX
+
+                bad = [
+                    c for c in jax.tree.leaves(tspec)
+                    if c not in (TORN_ATOMIC, TORN_LOSE, TORN_PREFIX)
+                ]
+                if bad or jax.tree.structure(tspec) != jax.tree.structure(spec):
+                    raise ValueError(
+                        f"{type(machine).__name__}.torn_spec() must be "
+                        f"congruent to durable_spec() with every leaf in "
+                        f"{{TORN_ATOMIC, TORN_LOSE, TORN_PREFIX}}"
+                    )
         # Coverage banded-slot layout version: the band field grows to 4
         # bits whenever any PR-5 chaos capability can occur (those are
         # new configs by definition, so every historical map keeps its
@@ -525,7 +601,7 @@ class Engine:
         self.cov_band_bits = (
             4
             if (fp.allow_pause or fp.allow_skew or fp.allow_dup
-                or fp.strict_restart)
+                or fp.strict_restart or fp.allow_torn or fp.allow_heal_asym)
             else 3
         )
         min_log2 = self.cov_band_bits + 3 + 1
@@ -550,8 +626,11 @@ class Engine:
             loss_possible=config.packet_loss_rate > 0 or fp.allow_storm,
             spike_possible=fp.allow_delay,
             delay_enabled=fp.allow_delay,
-            restart_possible=fp.allow_kill,
+            # torn restarts re-init through the machine like kill
+            # restarts do, so they need the restart key too
+            restart_possible=fp.allow_kill or fp.allow_torn,
             dup_possible=fp.allow_dup,
+            torn_possible=fp.allow_torn,
         )
 
     # -- lane init -----------------------------------------------------------
@@ -668,17 +747,50 @@ class Engine:
                         t + dur,
                         jnp.where(kind == K_SKEW, skew_q10, arg2),
                     )
-            for slot_off, (tt, op) in enumerate([(t, op_apply), (t + dur, op_undo)]):
-                i = n + 2 * f + slot_off
+                if fp.uses_storage_kinds:
+                    # one more draw — the torn damage mask, doubling as
+                    # the heal_asym second-direction duration — taken
+                    # only when torn/heal_asym are in the vocabulary, so
+                    # every window-kind-era schedule stays byte-stable.
+                    # Drawn unconditionally (constant draw count); a
+                    # fault is exactly one kind, so the word serves
+                    # whichever use that kind has.
+                    k_faults, k9 = jax.random.split(k_faults)
+                    storage_word = jax.random.bits(k9, (), jnp.uint32)
+                    # torn: arg2 carries the damage mask (int31 — the
+                    # payload is int32 and signs would survive replay,
+                    # but non-negative reads cleaner in traces)
+                    arg2 = jnp.where(
+                        kind == K_TORN,
+                        (storage_word & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32),
+                        arg2,
+                    )
+                    dur2 = jnp.int32(fp.dur_min_us) + (
+                        storage_word % jnp.uint32(fp.dur_max_us - fp.dur_min_us)
+                    ).astype(jnp.int32)
+            # slot layout per fault: [apply at t, undo at t+dur] plus,
+            # when heal_asym is enabled, a third slot for the second
+            # direction's heal at t+dur2 (valid only for heal_asym
+            # faults — other kinds leave it invalid, i.e. free space)
+            slot_events = [
+                (t, op_apply, arg1, arg2, None),
+                (t + dur, op_undo, arg1, arg2, None),
+            ]
+            if fp.allow_heal_asym:
+                slot_events.append(
+                    (t + dur2, jnp.int32(F_HASYM_HEAL), b, a, kind == K_HEAL_ASYM)
+                )
+            for slot_off, (tt, op, p1, p2, valid) in enumerate(slot_events):
+                i = n + fp.slots_per_fault * f + slot_off
                 msk = slots == i
                 eq_time = jnp.where(msk, tt, eq_time)
                 eq_seq = jnp.where(msk, next_seq + slot_off, eq_seq)
                 eq_kind = jnp.where(msk, EV_FAULT, eq_kind)
                 eq_node = jnp.where(msk, a, eq_node)
-                pay = jnp.stack([op, arg1, arg2] + [jnp.int32(0)] * (p - 3))
+                pay = jnp.stack([op, p1, p2] + [jnp.int32(0)] * (p - 3))
                 eq_payload = jnp.where(msk[:, None], pay[None, :], eq_payload)
-                eq_valid = eq_valid | msk
-            next_seq += 2
+                eq_valid = eq_valid | (msk if valid is None else (msk & valid))
+            next_seq += fp.slots_per_fault
 
         return LaneState(
             now_us=jnp.int32(0),
@@ -873,6 +985,14 @@ class Engine:
             touch_pair = (op == F_CLOG_PAIR) | (op == F_UNCLOG_PAIR)
             dir_val = op == F_CLOG_DIR
             touch_dir = (op == F_CLOG_DIR) | (op == F_UNCLOG_DIR)
+            if cfg.faults.allow_heal_asym:
+                # asymmetric partition: the apply op clogs the pair both
+                # ways (pair word ops); each F_HASYM_HEAL op unclogs the
+                # single direction arg1->arg2 (the dir word ops with
+                # dir_val False), so the two heals land independently
+                pair_val = pair_val | (op == F_HASYM)
+                touch_pair = touch_pair | (op == F_HASYM)
+                touch_dir = touch_dir | (op == F_HASYM_HEAL)
             touch_group = (op == F_CLOG_GROUP) | (op == F_UNCLOG_GROUP)
             idxs = jnp.arange(nn)
             # group membership: `a` carries mask bits [0, 30), `b` bits
@@ -925,10 +1045,17 @@ class Engine:
                 cross = in_g[:, None] != in_g[None, :]
                 clogged = jnp.where(touch_group & cross, op == F_CLOG_GROUP, clogged)
             a_mask = jnp.arange(nn) == a
+            kill_op = op == F_KILL
+            restart_op = op == F_RESTART
+            if cfg.faults.allow_torn:
+                # a torn fault is a kill whose restart goes through the
+                # torn_spec() storage contract instead of the model hook
+                kill_op = kill_op | (op == F_TORN)
+                restart_op = restart_op | (op == F_TORN_RESTART)
             killed = jnp.where(
-                op == F_KILL,
+                kill_op,
                 s.killed | a_mask,
-                jnp.where(op == F_RESTART, s.killed & ~a_mask, s.killed),
+                jnp.where(restart_op, s.killed & ~a_mask, s.killed),
             )
             # loss storm: `a` is the storm rate in 1/65536 units
             storm = jnp.where(
@@ -968,7 +1095,15 @@ class Engine:
                 s.nodes, a, op == F_RESTART, k_restart,
                 strict=cfg.faults.strict_restart,
             )
-            boot_node = jnp.where(op == F_RESTART, a, jnp.int32(-1))
+            if cfg.faults.allow_torn:
+                # torn/lost-write restart: the damage seed is the fault
+                # payload's schedule-drawn mask (b) salted by this
+                # step's torn RNG word — bit-deterministic on replay
+                torn_seed = b.astype(jnp.uint32) ^ step_words[layout.torn_off]
+                nodes = m.torn_restart_if(
+                    nodes, a, op == F_TORN_RESTART, k_restart, torn_seed
+                )
+            boot_node = jnp.where(restart_op, a, jnp.int32(-1))
             return (nodes, m.empty_outbox(), clogged, killed, storm, delay,
                     paused, skew, boot_node)
 

@@ -31,6 +31,35 @@ from flax import struct
 
 BOOT = 0  # reserved timer id
 
+# Storage-atomicity classes for torn/lost-write faults
+# (`Machine.torn_spec()`, consumed by `torn_restart_if`): what a torn
+# restart may do to a DURABLE leaf. Volatile leaves (durable_spec False)
+# ignore their class — they are wiped like any amnesia restart.
+TORN_ATOMIC = 1  # the write is atomic+fsynced: the leaf row survives intact
+TORN_LOSE = 2    # all-or-nothing lost write: the whole row may revert to
+#                  its fresh-init value (the write never reached the disk)
+TORN_PREFIX = 3  # torn multi-element write: the row keeps only a seeded
+#                  prefix along its trailing axis, the suffix reverts
+#                  (1-D rows degrade to TORN_LOSE — no axis to tear)
+
+# torn damage hash: mix a (payload ^ step-salt) seed word with the leaf's
+# static flatten index — murmur3-fmix-style, same avalanche family as
+# core.digest_fold / ops.coverage.cov_mix
+_TORN_GOLDEN = 0x9E3779B9
+_TORN_M1 = 0x85EBCA6B
+_TORN_M2 = 0xC2B2AE35
+
+
+def torn_hash(seed, leaf_idx: int) -> jax.Array:
+    """Deterministic uint32 damage word for durable leaf `leaf_idx`
+    (static flatten position) under the traced torn seed word."""
+    h = jnp.asarray(seed).astype(jnp.uint32) ^ jnp.uint32(
+        (_TORN_GOLDEN * (leaf_idx + 1)) & 0xFFFFFFFF
+    )
+    h = (h ^ (h >> 16)) * jnp.uint32(_TORN_M1)
+    h = (h ^ (h >> 13)) * jnp.uint32(_TORN_M2)
+    return h ^ (h >> 16)
+
 
 @struct.dataclass
 class Outbox:
@@ -193,6 +222,71 @@ class Machine:
             lambda durable, cur, f: cur if durable else set_at(cur, i, f, cond),
             spec, nodes, fresh,
         )
+
+    def torn_spec(self) -> Any:
+        """Optional storage-atomicity contract for torn/lost-write
+        faults (`FaultPlan.allow_torn`): a pytree CONGRUENT to `init()`'s
+        node state whose every leaf is one of TORN_ATOMIC / TORN_LOSE /
+        TORN_PREFIX — what a torn restart may do to that DURABLE leaf
+        (volatile leaves ignore their class; they are wiped like any
+        amnesia restart). Default None: every durable write is atomic
+        and fsynced, so a torn restart degrades to exactly the amnesia
+        wipe — a machine with only a `durable_spec()` gets the K_TORN
+        kind for free and survives it by construction. A machine
+        modelling a non-atomic storage path (a snapshot file written
+        without fsync, a multi-page WAL append) marks those leaves
+        TORN_LOSE / TORN_PREFIX, and its recovery path must tolerate
+        the damage or the checkers convict it — the FoundationDB
+        buggify finding class ("the disk lied")."""
+        return None
+
+    def torn_restart_if(self, nodes: Any, i, cond, rng_key, torn_seed) -> Any:
+        """Torn/lost-write restart (K_TORN undo op): volatile leaves
+        wipe exactly as `amnesia_restart_if`; each durable leaf then
+        takes its `torn_spec()` damage — TORN_LOSE rows revert whole
+        under a seeded coin, TORN_PREFIX rows keep only a seeded prefix
+        of their trailing axis. `torn_seed` is a traced uint32 (the
+        fault payload's schedule-drawn mask xor the step's torn salt
+        word); damage is a pure function of (torn_seed, leaf position),
+        so replays are bit-identical."""
+        spec = self.durable_spec()
+        if spec is None:
+            raise ValueError(
+                f"{type(self).__name__} declares no durable_spec(); "
+                f"allow_torn (torn/lost-write storage faults) needs the "
+                f"durable-state contract to know which leaves exist"
+            )
+        tspec = self.torn_spec()
+        if tspec is None:
+            tspec = jax.tree.map(lambda _leaf: TORN_ATOMIC, spec)
+        fresh = self.init(rng_key)
+        leaf_idx = [0]
+
+        def damage(durable, cls, cur, f):
+            li = leaf_idx[0]
+            leaf_idx[0] += 1
+            if not durable:
+                return set_at(cur, i, f, cond)  # amnesia wipe
+            if cls == TORN_ATOMIC:
+                return cur
+            h = torn_hash(torn_seed, li)
+            if cls == TORN_LOSE or cur.ndim < 2:
+                lost = (h & 1) == 1
+                return set_at(cur, i, f, cond & lost)
+            if cls == TORN_PREFIX:
+                size = cur.shape[-1]
+                cut = (h >> 1) % jnp.uint32(size + 1)
+                torn_tail = jnp.arange(size) >= cut.astype(jnp.int32)
+                row = (jnp.arange(cur.shape[0]) == i) & cond
+                mask = row.reshape((-1,) + (1,) * (cur.ndim - 1)) & torn_tail
+                return jnp.where(mask, f, cur)
+            raise ValueError(
+                f"{type(self).__name__}.torn_spec() leaf {li} has "
+                f"unknown atomicity class {cls!r} (expected TORN_ATOMIC/"
+                f"TORN_LOSE/TORN_PREFIX)"
+            )
+
+        return jax.tree.map(damage, spec, tspec, nodes, fresh)
 
     def restart_node_if(self, nodes: Any, i, cond, rng_key, strict: bool = False) -> Any:
         """Engine-facing restart dispatch — do NOT override. With

@@ -141,12 +141,15 @@ def _trace_affecting_key(engine: Engine) -> tuple:
         cfg.flight_recorder,
         cfg.fr_digest_every,
         cfg.fr_digest_ring,
-        # PR-5 chaos gates compiled INTO the step (defer logic, skew
-        # scaling, amnesia restart) — unlike the legacy kinds, which
-        # only shape the schedule in the initial state
+        # PR-5/PR-6 chaos gates compiled INTO the step (defer logic,
+        # skew scaling, amnesia/torn restarts, asymmetric-heal word
+        # ops) — unlike the legacy kinds, which only shape the schedule
+        # in the initial state
         cfg.faults.allow_pause,
         cfg.faults.allow_skew,
         cfg.faults.strict_restart,
+        cfg.faults.allow_torn,
+        cfg.faults.allow_heal_asym,
         engine._rng_layout,  # stream version + word-block layout (incl. dup)
         engine.use_pallas_pop,
     )

@@ -35,6 +35,8 @@ from .replay import ReplayResult, replay
 # minimal set leans on the legacy vocabulary when possible. Each entry
 # is (report name, FaultPlan field).
 ABLATABLE_KINDS = (
+    ("torn", "allow_torn"),
+    ("heal-asym", "allow_heal_asym"),
     ("delay", "allow_delay"),
     ("storm", "allow_storm"),
     ("group", "allow_group"),

@@ -1,0 +1,672 @@
+"""Raft with snapshotting + log compaction — the compound workload the
+torn/lost-write fault kind mines.
+
+Extends the flagship raft model (models/raft.py: leader election,
+single-entry AppendEntries, randomized timeouts) with the classic
+interaction-bug mine: every node periodically SNAPSHOTS its committed
+prefix and trims the log ring behind it, and a leader whose follower has
+fallen behind the trim point sends InstallSnapshot instead of
+AppendEntries (Raft §7). The log ring is windowed: stored slot `s` of a
+node holds the term of ABSOLUTE index `base + s`, slot 0 being the
+boundary term at `base` itself; `snap_idx`/`snap_term` describe the
+snapshot covering indices `[1, snap_idx]`. Honest compaction writes the
+snapshot and the trim in one atomic event, so `snap_idx == base` always
+— the load-bearing storage invariant torn-write faults attack.
+
+On-device invariants (checked after every event):
+  * ElectionSafety (code 101): at most one leader per term
+  * LogMatching on committed prefixes (code 102), compaction-aware:
+    (a) wherever two nodes both store and have both committed an
+        absolute position, the terms must agree (the stored windows are
+        aligned through each node's `base`);
+    (b) snapshot coverage: a node's committed watermark may only stand
+        on storage it can attest — `commit > snap_idx` with
+        `base > snap_idx` means positions in `(snap_idx, base]` are
+        claimed committed yet neither stored nor covered by the
+        snapshot. Honest nodes keep `snap_idx == base` so (b) can never
+        fire; a torn snapshot write (trim persisted, snapshot lost)
+        trips it at the node's first re-commit.
+
+The seeded bug (`demo-tornsnapshot-raft` / TornSnapshotRaftCompact):
+the snapshot file write is not fsynced — its `torn_spec()` marks
+`snap_idx`/`snap_term` TORN_LOSE while the trimmed log ring stays
+atomic. A torn restart (`FaultPlan.allow_torn`, K_TORN) then lands the
+node in exactly the state invariant (b) describes: trimmed log, no
+snapshot. The honest machine declares no torn_spec — every durable
+write atomic — so torn restarts degrade to the amnesia wipe and the
+model survives the full chaos palette clean.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from ..engine.machine import (
+    TORN_ATOMIC,
+    TORN_LOSE,
+    Machine,
+    Outbox,
+    make_payload,
+    send_if,
+    set_at,
+    set_timer_if,
+    update_node,
+)
+from ..utils import set2d
+from .raft import (
+    CANDIDATE,
+    CLIENT_APPEND_US,
+    ELECTION_MAX_US,
+    ELECTION_MIN_US,
+    ELECTION_SAFETY,
+    FOLLOWER,
+    HEARTBEAT_US,
+    LEADER,
+    LOG_MATCHING,
+    M_AE,
+    M_AER,
+    M_RV,
+    M_VOTE,
+    T_BOOT,
+    T_CLIENT,
+    T_ELECTION,
+    T_HEARTBEAT,
+)
+
+# InstallSnapshot (Raft §7): payload (M_IS, term, snap_idx, snap_term)
+M_IS = 5
+
+
+@struct.dataclass
+class RaftCompactState:
+    # persistent (stable storage)
+    term: jax.Array  # int32[N]
+    voted_for: jax.Array  # int32[N], -1 = none
+    log_term: jax.Array  # int32[N, CAP+1]; slot s = term at abs index base+s
+    log_len: jax.Array  # int32[N] stored entries past base (last abs = base+len)
+    base: jax.Array  # int32[N] trim boundary: entries <= base are compacted
+    snap_idx: jax.Array  # int32[N] snapshot covers [1, snap_idx] (== base honest)
+    snap_term: jax.Array  # int32[N] term at snap_idx
+    epoch: jax.Array  # int32[N] timer epoch (persistent, bumped at BOOT)
+    # volatile
+    role: jax.Array  # int32[N]
+    votes: jax.Array  # int32[N] granted-voter bitmask (dup-safe tally)
+    elec_deadline: jax.Array  # int32[N] us
+    commit: jax.Array  # int32[N] absolute watermark
+    next_idx: jax.Array  # int32[N, N] absolute
+    match_idx: jax.Array  # int32[N, N] absolute
+
+
+class RaftCompactMachine(Machine):
+    PAYLOAD_WIDTH = 6
+    MAX_TIMERS = 2
+
+    def __init__(
+        self,
+        num_nodes: int = 5,
+        log_capacity: int = 8,
+        compact_lag: int = 3,
+        target_commit: int = 0,
+    ):
+        if num_nodes > 31:
+            raise ValueError(
+                "RaftCompactMachine tracks granting voters as an int32 "
+                "bitmask (dup-safe tally, Raft §5.2); num_nodes must be "
+                "<= 31"
+            )
+        if not 1 <= compact_lag <= log_capacity:
+            raise ValueError("compact_lag must be in [1, log_capacity]")
+        self.NUM_NODES = num_nodes
+        self.MAX_MSGS = num_nodes - 1
+        self.log_capacity = log_capacity
+        self.compact_lag = compact_lag  # snapshot once commit-base reaches this
+        self.target_commit = target_commit or 2 * log_capacity
+        self.majority = num_nodes // 2 + 1
+
+    # -- state ---------------------------------------------------------------
+
+    def init(self, rng_key) -> RaftCompactState:
+        n, cap = self.NUM_NODES, self.log_capacity
+        z = jnp.zeros((n,), jnp.int32)
+        return RaftCompactState(
+            term=z,
+            voted_for=jnp.full((n,), -1, jnp.int32),
+            log_term=jnp.zeros((n, cap + 1), jnp.int32),
+            log_len=z,
+            base=z,
+            snap_idx=z,
+            snap_term=z,
+            epoch=z,
+            role=z,
+            votes=z,
+            elec_deadline=z,
+            commit=z,
+            next_idx=jnp.ones((n, n), jnp.int32),
+            match_idx=jnp.zeros((n, n), jnp.int32),
+        )
+
+    def durable_spec(self) -> RaftCompactState:
+        """term/votedFor/log window/trim boundary/snapshot metadata are
+        stable storage; the timer epoch must survive (it dies with the
+        node's timers otherwise); everything else is volatile. The
+        generic amnesia wipe under this spec is leaf-for-leaf identical
+        to `restart_if` (strict on/off bit-identical for the honest
+        machine)."""
+        return RaftCompactState(
+            term=True, voted_for=True, log_term=True, log_len=True,
+            base=True, snap_idx=True, snap_term=True, epoch=True,
+            role=False, votes=False, elec_deadline=False, commit=False,
+            next_idx=False, match_idx=False,
+        )
+
+    def restart_if(self, nodes: RaftCompactState, i, cond, rng_key) -> RaftCompactState:
+        n = self.NUM_NODES
+        row = (jnp.arange(n) == i) & cond
+        set_row = lambda arr, v: jnp.where(row, v, arr)  # noqa: E731
+        return nodes.replace(
+            role=set_row(nodes.role, FOLLOWER),
+            votes=set_row(nodes.votes, 0),
+            elec_deadline=set_row(nodes.elec_deadline, 0),
+            commit=set_row(nodes.commit, 0),
+            next_idx=jnp.where(row[:, None], 1, nodes.next_idx),
+            match_idx=jnp.where(row[:, None], 0, nodes.match_idx),
+        )
+
+    def init_node(self, nodes: RaftCompactState, i, rng_key) -> RaftCompactState:
+        return self.restart_if(nodes, i, jnp.bool_(True), rng_key)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _peers(self, node):
+        n = self.NUM_NODES
+        offs = jnp.arange(1, n, dtype=jnp.int32)
+        return (node + offs) % n
+
+    def _rand_timeout(self, rand_word):
+        span = jnp.uint32(ELECTION_MAX_US - ELECTION_MIN_US)
+        return jnp.int32(ELECTION_MIN_US) + (rand_word % span).astype(jnp.int32)
+
+    def _pay(self, *vals):
+        return make_payload(self.PAYLOAD_WIDTH, *vals)
+
+    def _tid(self, nodes, node, base):
+        return jnp.int32(base) + 4 * nodes.epoch[node]
+
+    def _term_at(self, nodes, node, abs_idx):
+        """Stored term at an absolute index, clipped into the node's
+        window — callers gate on validity themselves."""
+        rel = jnp.clip(abs_idx - nodes.base[node], 0, self.log_capacity)
+        return nodes.log_term[node, rel]
+
+    # granted-voter bitmask tally (dup-safe, mirrors models/raft.py)
+
+    def _vote_init(self, node):
+        return jnp.int32(1) << node
+
+    def _vote_add(self, votes, src, counts):
+        return jnp.where(counts, votes | (jnp.int32(1) << src), votes)
+
+    def _vote_count(self, votes):
+        return lax.population_count(votes.astype(jnp.uint32)).astype(jnp.int32)
+
+    # -- timers --------------------------------------------------------------
+
+    def on_timer(self, nodes: RaftCompactState, node, timer_id, now_us, rand_u32) -> Tuple[RaftCompactState, Outbox]:
+        outbox = self.empty_outbox()
+        cap = self.log_capacity
+        tbase = timer_id % 4
+        t_epoch = timer_id // 4
+        is_boot = timer_id == T_BOOT
+        live = is_boot | (t_epoch == nodes.epoch[node])
+
+        # ---- BOOT: bump epoch, arm election + client timers ----
+        new_epoch = jnp.where(is_boot & live, nodes.epoch[node] + 1, nodes.epoch[node])
+        nodes = update_node(nodes, node, epoch=new_epoch)
+        timeout = self._rand_timeout(rand_u32[0])
+        nodes = update_node(
+            nodes, node,
+            elec_deadline=jnp.where(
+                is_boot & live, now_us + timeout, nodes.elec_deadline[node]
+            ),
+        )
+        outbox = set_timer_if(outbox, 0, is_boot & live, timeout, self._tid(nodes, node, T_ELECTION))
+        outbox = set_timer_if(outbox, 1, is_boot & live, CLIENT_APPEND_US, self._tid(nodes, node, T_CLIENT))
+
+        # ---- ELECTION ----
+        is_elec = live & (tbase == T_ELECTION) & ~is_boot
+        not_yet = now_us < nodes.elec_deadline[node]
+        rearm_delay = jnp.maximum(nodes.elec_deadline[node] - now_us, 1)
+        outbox = set_timer_if(outbox, 0, is_elec & not_yet, rearm_delay, self._tid(nodes, node, T_ELECTION))
+
+        start = is_elec & ~not_yet & (nodes.role[node] != LEADER)
+        new_term = nodes.term[node] + 1
+        timeout2 = self._rand_timeout(rand_u32[1])
+        nodes = update_node(
+            nodes, node,
+            term=jnp.where(start, new_term, nodes.term[node]),
+            role=jnp.where(start, CANDIDATE, nodes.role[node]),
+            voted_for=jnp.where(start, node, nodes.voted_for[node]),
+            votes=jnp.where(start, self._vote_init(node), nodes.votes[node]),
+            elec_deadline=jnp.where(start, now_us + timeout2, nodes.elec_deadline[node]),
+        )
+        outbox = set_timer_if(
+            outbox, 0, is_elec & ~not_yet, timeout2, self._tid(nodes, node, T_ELECTION)
+        )
+        last_idx = nodes.base[node] + nodes.log_len[node]  # absolute
+        last_term = nodes.log_term[node, nodes.log_len[node]]
+        rv = self._pay(M_RV, nodes.term[node], node, last_idx, last_term)
+        peers = self._peers(node)
+        for s in range(self.MAX_MSGS):
+            outbox = send_if(outbox, s, start, peers[s], rv)
+
+        # ---- HEARTBEAT (leader replicates; snapshot when peer is
+        #      behind the trim point) ----
+        is_hb = live & (tbase == T_HEARTBEAT) & ~is_boot
+        is_leader = nodes.role[node] == LEADER
+        do_hb = is_hb & is_leader
+        outbox = set_timer_if(outbox, 1, do_hb, HEARTBEAT_US, self._tid(nodes, node, T_HEARTBEAT))
+        for s in range(self.MAX_MSGS):
+            peer = peers[s]
+            ni = nodes.next_idx[node, peer]  # absolute
+            need_snap = ni <= nodes.base[node]  # entries trimmed away
+            prev_idx = ni - 1
+            prev_term = self._term_at(nodes, node, prev_idx)
+            has_entry = ni <= nodes.base[node] + nodes.log_len[node]
+            entry_term = jnp.where(has_entry, self._term_at(nodes, node, ni), 0)
+            ae = self._pay(M_AE, nodes.term[node], prev_idx, prev_term, entry_term, nodes.commit[node])
+            inst = self._pay(M_IS, nodes.term[node], nodes.snap_idx[node], nodes.snap_term[node])
+            outbox = send_if(outbox, s, do_hb, peer, jnp.where(need_snap, inst, ae))
+
+        # ---- CLIENT tick: compact own log, then (leader) append ----
+        is_client = live & (tbase == T_CLIENT) & ~is_boot
+        outbox = set_timer_if(outbox, 1, is_client & ~do_hb, CLIENT_APPEND_US, self._tid(nodes, node, T_CLIENT))
+
+        # Compaction (every node, its own log): once the committed
+        # prefix has outgrown compact_lag, snapshot AT the commit point
+        # and trim the ring behind it. Snapshot metadata and trim are
+        # written in this ONE event — the atomicity the torn fault tests.
+        lag = nodes.commit[node] - nodes.base[node]  # <= log_len always
+        do_compact = is_client & (lag >= self.compact_lag)
+        shift = jnp.where(
+            do_compact, jnp.clip(jnp.minimum(lag, nodes.log_len[node]), 0, cap), 0
+        )
+        srel = jnp.arange(cap + 1, dtype=jnp.int32)
+        row = nodes.log_term[node]
+        shifted = jnp.where(srel + shift <= cap, row[jnp.clip(srel + shift, 0, cap)], 0)
+        boundary_term = row[jnp.clip(shift, 0, cap)]
+        nodes = update_node(
+            nodes, node,
+            log_term=jnp.where(do_compact, shifted, row),
+            log_len=jnp.where(do_compact, nodes.log_len[node] - shift, nodes.log_len[node]),
+            base=jnp.where(do_compact, nodes.base[node] + shift, nodes.base[node]),
+            snap_idx=jnp.where(do_compact, nodes.base[node] + shift, nodes.snap_idx[node]),
+            snap_term=jnp.where(do_compact, boundary_term, nodes.snap_term[node]),
+        )
+
+        # leader client append (post-compaction state)
+        can_append = is_client & is_leader & (nodes.log_len[node] < cap)
+        new_len = nodes.log_len[node] + 1
+        slot = jnp.clip(new_len, 0, cap)
+        nodes = update_node(
+            nodes, node,
+            log_len=jnp.where(can_append, new_len, nodes.log_len[node]),
+            log_term=jnp.where(
+                can_append,
+                set_at(nodes.log_term[node], slot, nodes.term[node]),
+                nodes.log_term[node],
+            ),
+        )
+        nodes = nodes.replace(
+            match_idx=jnp.where(
+                can_append,
+                set2d(nodes.match_idx, node, node, nodes.base[node] + new_len),
+                nodes.match_idx,
+            )
+        )
+        return nodes, outbox
+
+    # -- messages ------------------------------------------------------------
+
+    def on_message(self, nodes: RaftCompactState, node, src, payload, now_us, rand_u32) -> Tuple[RaftCompactState, Outbox]:
+        mtype = payload[0]
+        branch = jnp.clip(mtype - 1, 0, 4)
+        cap = self.log_capacity
+
+        def step_down(nodes, t, also_follow):
+            """Common term bookkeeping: adopt newer terms; `also_follow`
+            additionally demotes on equal-term leader contact."""
+            newer = t > nodes.term[node]
+            return update_node(
+                nodes, node,
+                term=jnp.where(newer, t, nodes.term[node]),
+                role=jnp.where(
+                    newer | (also_follow & (t == nodes.term[node])),
+                    FOLLOWER, nodes.role[node],
+                ),
+                voted_for=jnp.where(newer, -1, nodes.voted_for[node]),
+            )
+
+        def rv_branch(args):
+            nodes, = args
+            outbox = self.empty_outbox()
+            t, cand, last_idx, last_term = payload[1], payload[2], payload[3], payload[4]
+            nodes = step_down(nodes, t, jnp.bool_(False))
+            my_last = nodes.base[node] + nodes.log_len[node]
+            my_last_term = nodes.log_term[node, nodes.log_len[node]]
+            log_ok = (last_term > my_last_term) | (
+                (last_term == my_last_term) & (last_idx >= my_last)
+            )
+            can_vote = (nodes.voted_for[node] == -1) | (nodes.voted_for[node] == cand)
+            grant = (t == nodes.term[node]) & can_vote & log_ok
+            nodes = update_node(
+                nodes, node,
+                voted_for=jnp.where(grant, cand, nodes.voted_for[node]),
+                elec_deadline=jnp.where(
+                    grant, now_us + self._rand_timeout(rand_u32[0]), nodes.elec_deadline[node]
+                ),
+            )
+            vote = self._pay(M_VOTE, nodes.term[node], grant.astype(jnp.int32))
+            outbox = send_if(outbox, 0, jnp.bool_(True), src, vote)
+            return nodes, outbox
+
+        def vote_branch(args):
+            nodes, = args
+            outbox = self.empty_outbox()
+            t, granted = payload[1], payload[2]
+            nodes = step_down(nodes, t, jnp.bool_(False))
+            counts = (t == nodes.term[node]) & (nodes.role[node] == CANDIDATE) & (granted == 1)
+            new_votes = self._vote_add(nodes.votes[node], src, counts)
+            win = (
+                counts
+                & (self._vote_count(new_votes) >= self.majority)
+                & (nodes.role[node] == CANDIDATE)
+            )
+            n = self.NUM_NODES
+            my_last = nodes.base[node] + nodes.log_len[node]
+            nodes = update_node(
+                nodes, node, votes=new_votes,
+                role=jnp.where(win, LEADER, nodes.role[node]),
+            )
+            nodes = nodes.replace(
+                next_idx=jnp.where(
+                    win,
+                    set_at(nodes.next_idx, node, jnp.full((n,), 0, jnp.int32) + my_last + 1),
+                    nodes.next_idx,
+                ),
+                match_idx=jnp.where(
+                    win,
+                    set_at(
+                        nodes.match_idx, node,
+                        set_at(jnp.zeros((n,), jnp.int32), node, my_last),
+                    ),
+                    nodes.match_idx,
+                ),
+            )
+            peers = self._peers(node)
+            prev_term = nodes.log_term[node, nodes.log_len[node]]
+            ae = self._pay(M_AE, nodes.term[node], my_last, prev_term, 0, nodes.commit[node])
+            for s in range(self.MAX_MSGS):
+                outbox = send_if(outbox, s, win, peers[s], ae)
+            outbox = set_timer_if(outbox, 0, win, HEARTBEAT_US, self._tid(nodes, node, T_HEARTBEAT))
+            return nodes, outbox
+
+        def ae_branch(args):
+            nodes, = args
+            outbox = self.empty_outbox()
+            t, prev_idx, prev_term, entry_term, leader_commit = (
+                payload[1], payload[2], payload[3], payload[4], payload[5],
+            )
+            stale = t < nodes.term[node]
+            nodes = step_down(nodes, t, jnp.bool_(True))
+            nodes = update_node(
+                nodes, node,
+                elec_deadline=jnp.where(
+                    ~stale, now_us + self._rand_timeout(rand_u32[0]), nodes.elec_deadline[node]
+                ),
+            )
+            base = nodes.base[node]
+            stored_last = base + nodes.log_len[node]
+            prev_rel = prev_idx - base
+            within = (prev_rel >= 0) & (prev_idx <= stored_last)
+            match_here = within & (nodes.log_term[node, jnp.clip(prev_rel, 0, cap)] == prev_term)
+            # prev below the trim point: the snapshot attests the whole
+            # committed prefix, treat as matching (no entry to store)
+            log_ok = match_here | (prev_idx < base)
+            ok = ~stale & log_ok
+            has_entry = entry_term > 0
+            slot_rel = prev_rel + 1
+            can_store = (slot_rel >= 1) & (slot_rel <= cap)
+            slot = jnp.clip(slot_rel, 0, cap)
+            existing_matches = (stored_last >= prev_idx + 1) & can_store & (
+                nodes.log_term[node, slot] == entry_term
+            )
+            append = ok & has_entry & can_store
+            new_last = jnp.where(
+                append,
+                jnp.where(
+                    existing_matches,
+                    jnp.maximum(stored_last, prev_idx + 1),
+                    prev_idx + 1,
+                ),
+                stored_last,
+            )
+            # Raft §5.3 commit bound: cap at the last index THIS AE
+            # verified, never the follower's own tail
+            last_new = prev_idx + jnp.where(append, 1, 0)
+            commit_cap = jnp.minimum(last_new, new_last)
+            nodes = update_node(
+                nodes, node,
+                log_term=jnp.where(
+                    append, set_at(nodes.log_term[node], slot, entry_term), nodes.log_term[node]
+                ),
+                log_len=new_last - base,
+                commit=jnp.where(
+                    ok,
+                    jnp.maximum(nodes.commit[node], jnp.minimum(leader_commit, commit_cap)),
+                    nodes.commit[node],
+                ),
+            )
+            midx = jnp.where(
+                append, prev_idx + 1,
+                jnp.where(prev_idx < base, base, prev_idx),
+            )
+            aer = self._pay(M_AER, nodes.term[node], ok.astype(jnp.int32), midx)
+            outbox = send_if(outbox, 0, jnp.bool_(True), src, aer)
+            return nodes, outbox
+
+        def aer_branch(args):
+            nodes, = args
+            outbox = self.empty_outbox()
+            t, success, midx = payload[1], payload[2], payload[3]
+            nodes = step_down(nodes, t, jnp.bool_(False))
+            is_lead = (nodes.role[node] == LEADER) & (t == nodes.term[node])
+            good = is_lead & (success == 1)
+            new_match = jnp.maximum(nodes.match_idx[node, src], midx)
+            nodes = nodes.replace(
+                match_idx=jnp.where(
+                    good, set2d(nodes.match_idx, node, src, new_match), nodes.match_idx
+                ),
+                next_idx=jnp.where(
+                    good,
+                    set2d(nodes.next_idx, node, src, new_match + 1),
+                    jnp.where(
+                        is_lead & (success == 0),
+                        set2d(
+                            nodes.next_idx, node, src,
+                            jnp.maximum(nodes.next_idx[node, src] - 1, 1),
+                        ),
+                        nodes.next_idx,
+                    ),
+                ),
+            )
+            # advance commit: highest STORED idx replicated on a
+            # majority with a current-term entry (Raft §5.4.2); indices
+            # below base were committed before they compacted
+            srel = jnp.arange(cap + 1, dtype=jnp.int32)
+            abs_idx = nodes.base[node] + srel
+            replicated = nodes.match_idx[node][None, :] >= abs_idx[:, None]
+            cnt = jnp.sum(replicated, axis=1)
+            cur_term_entry = nodes.log_term[node] == nodes.term[node]
+            committable = (
+                (cnt >= self.majority) & cur_term_entry
+                & (srel >= 1) & (srel <= nodes.log_len[node])
+            )
+            best = jnp.max(jnp.where(committable, abs_idx, 0))
+            nodes = update_node(
+                nodes, node,
+                commit=jnp.where(good, jnp.maximum(nodes.commit[node], best), nodes.commit[node]),
+            )
+            return nodes, outbox
+
+        def is_branch(args):
+            nodes, = args
+            outbox = self.empty_outbox()
+            t, s_idx, s_term = payload[1], payload[2], payload[3]
+            stale = t < nodes.term[node]
+            nodes = step_down(nodes, t, jnp.bool_(True))
+            nodes = update_node(
+                nodes, node,
+                elec_deadline=jnp.where(
+                    ~stale, now_us + self._rand_timeout(rand_u32[0]), nodes.elec_deadline[node]
+                ),
+            )
+            base = nodes.base[node]
+            apply = ~stale & (s_idx > nodes.commit[node])
+            rel = s_idx - base
+            have_boundary = (
+                (rel >= 0) & (s_idx <= base + nodes.log_len[node])
+                & (nodes.log_term[node, jnp.clip(rel, 0, cap)] == s_term)
+            )
+            retain = apply & have_boundary  # keep the suffix past s_idx
+            shift = jnp.where(retain, jnp.clip(rel, 0, cap), 0)
+            srel = jnp.arange(cap + 1, dtype=jnp.int32)
+            row = nodes.log_term[node]
+            shifted = jnp.where(srel + shift <= cap, row[jnp.clip(srel + shift, 0, cap)], 0)
+            discard_row = jnp.where(srel == 0, s_term, 0)
+            new_row = jnp.where(apply, jnp.where(retain, shifted, discard_row), row)
+            new_len = jnp.where(
+                apply,
+                jnp.where(retain, base + nodes.log_len[node] - s_idx, 0),
+                nodes.log_len[node],
+            )
+            nodes = update_node(
+                nodes, node,
+                log_term=new_row,
+                log_len=new_len,
+                base=jnp.where(apply, s_idx, base),
+                snap_idx=jnp.where(apply, s_idx, nodes.snap_idx[node]),
+                snap_term=jnp.where(apply, s_term, nodes.snap_term[node]),
+                commit=jnp.where(apply, jnp.maximum(nodes.commit[node], s_idx), nodes.commit[node]),
+            )
+            aer = self._pay(
+                M_AER, nodes.term[node], (~stale).astype(jnp.int32), s_idx
+            )
+            outbox = send_if(outbox, 0, jnp.bool_(True), src, aer)
+            return nodes, outbox
+
+        return lax.switch(
+            branch, [rv_branch, vote_branch, ae_branch, aer_branch, is_branch], (nodes,)
+        )
+
+    # -- invariants / results ------------------------------------------------
+
+    def invariant(self, nodes: RaftCompactState, now_us):
+        n, cap = self.NUM_NODES, self.log_capacity
+        is_lead = nodes.role == LEADER
+        same_term = nodes.term[:, None] == nodes.term[None, :]
+        both_lead = is_lead[:, None] & is_lead[None, :] & ~jnp.eye(n, dtype=bool)
+        elec_viol = jnp.any(both_lead & same_term)
+
+        # (a) committed stored windows agree pairwise: node i's slot s
+        # holds absolute position base_i+s; find that position in j's
+        # frame and compare terms wherever both store AND both committed
+        # it. Slot 0 (the boundary term at base) participates — honest
+        # compaction writes it from a committed entry.
+        s = jnp.arange(cap + 1, dtype=jnp.int32)
+        abs_i = nodes.base[:, None] + s[None, :]  # [N, S]
+        known_i = (s[None, :] <= nodes.log_len[:, None]) & (abs_i >= 1)
+        committed_i = known_i & (abs_i <= nodes.commit[:, None])
+        rel_j = abs_i[:, None, :] - nodes.base[None, :, None]  # [N, N, S]
+        known_j = (rel_j >= 0) & (rel_j <= nodes.log_len[None, :, None])
+        committed_j = known_j & (abs_i[:, None, :] <= nodes.commit[None, :, None])
+        tj = jnp.take_along_axis(
+            jnp.broadcast_to(nodes.log_term[None, :, :], (n, n, cap + 1)),
+            jnp.clip(rel_j, 0, cap),
+            axis=2,
+        )
+        ti = jnp.broadcast_to(nodes.log_term[:, None, :], (n, n, cap + 1))
+        log_viol = jnp.any(committed_i[:, None, :] & committed_j & (ti != tj))
+
+        # (b) snapshot coverage: a committed watermark must stand on
+        # attested storage — positions in (snap_idx, base] are neither
+        # stored nor snapshot-covered, so committing past snap_idx with
+        # base > snap_idx is data loss (the torn-snapshot signature;
+        # honest nodes keep snap_idx == base and can never trip this)
+        cover_viol = jnp.any(
+            (nodes.base > nodes.snap_idx) & (nodes.commit > nodes.snap_idx)
+        )
+
+        ok = ~(elec_viol | log_viol | cover_viol)
+        code = jnp.where(
+            elec_viol, ELECTION_SAFETY,
+            jnp.where(log_viol | cover_viol, LOG_MATCHING, 0),
+        )
+        return ok, code.astype(jnp.int32)
+
+    def is_done(self, nodes: RaftCompactState, now_us):
+        return jnp.all(nodes.commit >= self.target_commit)
+
+    def summary(self, nodes: RaftCompactState):
+        return {
+            "max_term": jnp.max(nodes.term),
+            "max_commit": jnp.max(nodes.commit),
+            "min_commit": jnp.min(nodes.commit),
+            "num_leaders": jnp.sum((nodes.role == LEADER).astype(jnp.int32)),
+            "max_base": jnp.max(nodes.base),
+        }
+
+    def coverage_projection(self, nodes: RaftCompactState, now_us):
+        """Raft's cluster-shape axes (term bucket / leaders / commit
+        divergence) plus the compaction axes: how far trim boundaries
+        diverge across nodes and how many snapshot generations the
+        cluster is into — the interleavings that only exist because the
+        log has a moving floor."""
+        term_b = jnp.clip(jnp.max(nodes.term), 0, 7)  # phase bits
+        leaders = jnp.clip(jnp.sum((nodes.role == LEADER).astype(jnp.int32)), 0, 3)
+        commit_div = jnp.clip(jnp.max(nodes.commit) - jnp.min(nodes.commit), 0, 7)
+        base_div = jnp.clip(jnp.max(nodes.base) - jnp.min(nodes.base), 0, 7)
+        snap_gen = jnp.clip(jnp.max(nodes.base) // self.compact_lag, 0, 3)
+        return (
+            term_b
+            | (leaders << 3)
+            | (commit_div << 5)
+            | (base_div << 8)
+            | (snap_gen << 11)
+        ).astype(jnp.uint32)
+
+
+class TornSnapshotRaftCompact(RaftCompactMachine):
+    """Seeded storage bug (`demo-tornsnapshot-raft`): the snapshot file
+    write is never fsynced, so a crash can keep the trimmed log ring
+    (atomic) while LOSING the snapshot covering everything behind it.
+    Only a torn restart (`FaultPlan.allow_torn`) can surface it — plain
+    kill/restart and even strict amnesia honor durable_spec, under which
+    the snapshot metadata survives. The first re-commit after the torn
+    restart trips the compaction-aware LogMatching checker (code 102):
+    the node's watermark stands on positions neither stored nor
+    attested."""
+
+    def torn_spec(self) -> RaftCompactState:
+        return RaftCompactState(
+            term=TORN_ATOMIC, voted_for=TORN_ATOMIC,
+            log_term=TORN_ATOMIC, log_len=TORN_ATOMIC, base=TORN_ATOMIC,
+            snap_idx=TORN_LOSE, snap_term=TORN_LOSE,
+            epoch=TORN_ATOMIC,
+            role=TORN_ATOMIC, votes=TORN_ATOMIC, elec_deadline=TORN_ATOMIC,
+            commit=TORN_ATOMIC, next_idx=TORN_ATOMIC, match_idx=TORN_ATOMIC,
+        )

@@ -75,12 +75,17 @@ COV_BANDS = 1 << COV_BAND_BITS
 COV_BAND_NAMES = ("timer", "msg", "pair", "kill", "dir", "group", "storm", "delay")
 COV_BAND_NAMES_V2 = COV_BAND_NAMES + (
     "pause", "skew", "dup", "amnesia",
-    "reserved12", "reserved13", "reserved14", "reserved15",
+    "torn", "heal_asym", "reserved14", "reserved15",
 )
 # v2 synthetic bands (no popped-event class of their own; the engine
 # passes them via cov_slot's `band` override)
 COV_BAND_DUP = 10
 COV_BAND_AMNESIA = 11
+# Scheduled kinds past the synthetic bands (PR-6): fault kind k >= 8
+# (K_TORN, K_HEAL_ASYM) lands at band 4 + k — the 2 + k rule would
+# collide with the dup/amnesia slots. Only expressible in the 4-bit
+# layout; the engine forces it whenever these kinds are enabled.
+COV_KIND_BAND_SHIFT_AT = 8
 
 # mix constants: murmur3 fmix / Weyl — odd multipliers, same family as
 # core.digest_fold (any single-bit input change avalanches)
@@ -101,12 +106,23 @@ def cov_mix(words) -> jax.Array:
 
 def cov_band(ev_kind, op_word, band_bits: int = COV_BAND_BITS) -> jax.Array:
     """Band index of a popped event: timer 0 / msg 1 / fault 2+kind
-    (apply and undo share a kind). EV_FAULT mirrored as a literal (2):
-    engine.core imports this module."""
+    (apply and undo share a kind; kinds >= COV_KIND_BAND_SHIFT_AT map to
+    4+kind in the 4-bit layout — past the synthetic dup/amnesia bands).
+    EV_FAULT mirrored as a literal (2): engine.core imports this
+    module."""
     ev_kind = jnp.asarray(ev_kind).astype(jnp.int32)
     bands = 1 << band_bits
-    fault_kind = jnp.clip(jnp.asarray(op_word).astype(jnp.int32) // 2, 0, bands - 3)
-    return jnp.where(ev_kind == 2, 2 + fault_kind, jnp.clip(ev_kind, 0, 1))
+    kind = jnp.asarray(op_word).astype(jnp.int32) // 2
+    if band_bits <= COV_BAND_BITS:
+        # v1 layout: the PR-4 formula, bit-exact (golden slot constants)
+        fault_band = 2 + jnp.clip(kind, 0, bands - 3)
+    else:
+        fault_band = jnp.where(
+            kind >= COV_KIND_BAND_SHIFT_AT,
+            4 + jnp.clip(kind, COV_KIND_BAND_SHIFT_AT, bands - 5),
+            2 + jnp.clip(kind, 0, COV_KIND_BAND_SHIFT_AT - 1),
+        )
+    return jnp.where(ev_kind == 2, fault_band, jnp.clip(ev_kind, 0, 1))
 
 
 def cov_slot(

@@ -37,14 +37,16 @@ generalization of the v2 block draw.
 
 Both versions share the same block layout (`StepRngLayout`):
 
-    [ handler H | latency M | drop M? | spike M? | spike_mag M? | restart 2? | dup 2M? ]
+    [ handler H | latency M | drop M? | spike M? | spike_mag M? | restart 2? | dup 2M? | torn 1? ]
 
 v2 always materializes the drop (and, under `allow_delay`, spike)
 sections; v3 omits statically-dead sections entirely. The duplication
 section (`FaultPlan.allow_dup`, PR-5: gate word + fresh-latency word per
 message slot) is appended at the END of both layouts — existing section
 offsets never move, so every recorded stream stays byte-stable with the
-flag off. The engine
+flag off. The torn-write salt section (`FaultPlan.allow_torn`, PR-6: one
+word per step, folded into the torn-restart damage draw) appends after
+it under the same contract. The engine
 additionally elides the *compute* that consumes a section when it is
 statically inert (e.g. loss_rate==0 and no storms ⇒ the drop compare
 always yields False) — that elision is result-preserving in both
@@ -114,6 +116,13 @@ class StepRngLayout:
     # flag-off block is bit-identical to the pre-dup layouts.
     dup_off: Optional[int] = None
     dup_active: bool = False
+    # torn-write section (PR-6, `FaultPlan.allow_torn`): ONE word per
+    # step that salts the torn-restart damage draw (combined with the
+    # fault payload's schedule-drawn mask). Appended after the dup
+    # section at the very tail of both versions — same off-bit-stability
+    # contract: no existing offset ever moves.
+    torn_off: Optional[int] = None
+    torn_active: bool = False
 
 
 def layout_for(
@@ -126,15 +135,19 @@ def layout_for(
     delay_enabled: bool,
     restart_possible: bool,
     dup_possible: bool = False,
+    torn_possible: bool = False,
 ) -> StepRngLayout:
     """Build the block layout. `delay_enabled` is the raw
     `FaultPlan.allow_delay` flag (v2 materializes spike words on it
     alone); `spike_possible` additionally requires n_faults > 0.
     `dup_possible` (`FaultPlan.allow_dup`) appends the duplication
-    section to the tail of either version — never moves an offset."""
+    section to the tail of either version — never moves an offset —
+    and `torn_possible` (`FaultPlan.allow_torn`) appends the one-word
+    torn-write salt section after it, under the same contract."""
     h, m = handler_words, max_msgs
     if version == RNG_STREAM_LEGACY:
         legacy_total = h + (4 if delay_enabled else 2) * m
+        dup_end = legacy_total + (2 * m if dup_possible else 0)
         return StepRngLayout(
             version=version,
             handler_words=h,
@@ -143,12 +156,14 @@ def layout_for(
             drop_off=h + m,
             spike_off=h + 2 * m if delay_enabled else None,
             restart_off=None,
-            total_words=legacy_total + (2 * m if dup_possible else 0),
+            total_words=dup_end + (1 if torn_possible else 0),
             loss_active=loss_possible,
             spike_active=delay_enabled and spike_possible,
             restart_active=restart_possible,
             dup_off=legacy_total if dup_possible else None,
             dup_active=dup_possible,
+            torn_off=dup_end if torn_possible else None,
+            torn_active=torn_possible,
         )
     if version != RNG_STREAM_COUNTER:
         raise ValueError(f"unknown rng_stream version {version!r}")
@@ -169,6 +184,10 @@ def layout_for(
     if dup_possible:
         dup_off = cursor
         cursor += 2 * m
+    torn_off = None
+    if torn_possible:
+        torn_off = cursor
+        cursor += 1
     return StepRngLayout(
         version=version,
         handler_words=h,
@@ -183,6 +202,8 @@ def layout_for(
         restart_active=restart_possible,
         dup_off=dup_off,
         dup_active=dup_possible,
+        torn_off=torn_off,
+        torn_active=torn_possible,
     )
 
 

@@ -47,7 +47,7 @@ COV_PHASES = 1 << COV_PHASE_BITS
 COV_BAND_NAMES = ("timer", "msg", "pair", "kill", "dir", "group", "storm", "delay")
 COV_BAND_NAMES_V2 = COV_BAND_NAMES + (
     "pause", "skew", "dup", "amnesia",
-    "reserved12", "reserved13", "reserved14", "reserved15",
+    "torn", "heal_asym", "reserved14", "reserved15",
 )
 
 # doc v1: band_bits implicitly 3; v2 carries an explicit band_bits field

@@ -19,7 +19,8 @@ if TYPE_CHECKING:
 # FR_METRICS_LEN (kept as literals here so this host-side module never
 # imports jax).
 FR_FAULT_KINDS = (
-    "pair", "kill", "dir", "group", "storm", "delay", "pause", "skew"
+    "pair", "kill", "dir", "group", "storm", "delay", "pause", "skew",
+    "torn", "heal-asym",
 )
 FR_EXTRAS = ("dup", "amnesia")
 

@@ -190,6 +190,79 @@ SKEW_ONLY_ROWS_7 = {
 }
 
 
+# v3 counter stream WITH the PR-6 torn-write salt section: (4, 4,
+# no-delay, kill) plus allow_torn => 11-word block
+# [handler 4 | lat 4 | restart 2 | torn 1]. New W, new pinned stream;
+# the torn-OFF block (V3_WORDS) is untouched — the byte-stability
+# contract, again.
+V3_TORN_WORDS = {
+    7: [
+        [2686112139, 1920907495, 3117116237, 1839934677, 1453259340, 1192845063,
+         3456765616, 1606147535, 3603694514, 2566954649, 584178859],
+        [1281725469, 2899835270, 3407625762, 1157853032, 3943749771, 3821801872,
+         720138553, 690176044, 108529684, 1925277224, 876130989],
+    ],
+    123: [
+        [1497626296, 220333688, 3958732928, 105686110, 3354259625, 897652912,
+         407698561, 1257635799, 1854429325, 2521537040, 3730749344],
+        [4270409091, 535029018, 814983135, 2487286935, 4015632930, 797900295,
+         1741178096, 1288928074, 3262815166, 1673231734, 299123086],
+    ],
+}
+
+# v2 + torn, steps 0-1: the first 12 words must BE V2_WORDS (the torn
+# salt rides the tail; jax.random.bits extends the counter, so the
+# legacy prefix is untouched) — the pinned tail words follow. Note
+# V2_TORN_TAIL[7][0] == V2_DUP_TAIL_7[0]: with dup off the torn section
+# claims block word 12, and the counter-extension property makes word 12
+# the same bits no matter which section owns it.
+V2_TORN_TAIL = {
+    7: [1537568898, 2579175849],
+    123: [4199490399, 379683286],
+}
+
+# Storage-kind (torn/heal-asym) fault schedules. The extra per-fault
+# draw (the torn damage mask / heal-asym second duration) shifts the
+# k_faults chain, and heal-asym gives every fault a THIRD slot (invalid
+# for other kinds), so schedules with storage kinds enabled are a NEW
+# pinned derivation; V1_SCHED/V2_SCHED/WINDOW_SCHED passing untouched is
+# the off-bit-stability proof. TORN rows pin arg2 = the damage mask;
+# HASYM rows pin the op-18 both-way clog plus the two op-19 one-way
+# heals at independently drawn times.
+STORAGE_FAULTS = dataclasses.replace(
+    WINDOW_FAULTS, allow_torn=True, allow_heal_asym=True
+)
+STORAGE_SCHED = {
+    7: {
+        "time": [2359908, 2901252, 2971861, 1434940, 1923642, 1955941],
+        "seq": [5, 6, 7, 8, 9, 10],
+        "node": [2, 2, 2, 0, 0, 0],
+        "valid": [True, True, False, True, True, True],
+        "pay": [[12, 2, 2901252, 0, 0, 0], [13, 2, 2901252, 0, 0, 0],
+                [19, 0, 2, 0, 0, 0], [18, 0, 1, 0, 0, 0],
+                [19, 0, 1, 0, 0, 0], [19, 1, 0, 0, 0, 0]],
+    },
+    123: {
+        "time": [2025571, 2552840, 2672247, 1484037, 2082825, 1881822],
+        "seq": [5, 6, 7, 8, 9, 10],
+        "node": [1, 1, 1, 1, 1, 1],
+        "valid": [True, True, False, True, True, False],
+        "pay": [[12, 1, 2552840, 0, 0, 0], [13, 1, 2552840, 0, 0, 0],
+                [19, 2, 1, 0, 0, 0], [2, 1, 2, 0, 0, 0],
+                [3, 1, 2, 0, 0, 0], [19, 2, 1, 0, 0, 0]],
+    },
+}
+TORN_ONLY_ROWS_7 = {
+    "time": [359908, 701252], "node": [2, 2], "valid": [True, True],
+    "pay": [[16, 2, 1754838184, 0, 0, 0], [17, 2, 1754838184, 0, 0, 0]],
+}
+HASYM_ONLY_ROWS_7 = {
+    "time": [359908, 701252, 681740], "node": [2, 2, 2],
+    "valid": [True, True, True],
+    "pay": [[18, 2, 0, 0, 0, 0], [19, 2, 0, 0, 0, 0], [19, 0, 2, 0, 0, 0]],
+}
+
+
 def _lane_key(seed):
     key = jax.random.PRNGKey(seed)
     key, _k_init, _k_faults = jax.random.split(key, 3)
@@ -351,6 +424,118 @@ def test_window_kind_fault_schedules_pinned():
         rows = slice(5, 7)
         assert s.eq_time[rows].tolist() == expect["time"], kind_flags
         assert s.eq_node[rows].tolist() == expect["node"], kind_flags
+        assert s.eq_payload[rows].tolist() == expect["pay"], kind_flags
+
+
+def test_torn_section_rides_the_tail():
+    """The torn salt section appends AFTER the dup section at the very
+    tail of both layouts without moving an existing offset — the
+    off-bit-stability proof at the layout level."""
+    base3 = _v3_layout()
+    torn3 = layout_for(
+        RNG_STREAM_COUNTER, 4, 4, loss_possible=False, spike_possible=False,
+        delay_enabled=False, restart_possible=True, torn_possible=True,
+    )
+    assert (torn3.lat_off, torn3.restart_off) == (base3.lat_off, base3.restart_off)
+    assert torn3.torn_off == base3.total_words == 10
+    assert torn3.total_words == 11
+    both3 = layout_for(
+        RNG_STREAM_COUNTER, 4, 4, loss_possible=False, spike_possible=False,
+        delay_enabled=False, restart_possible=True, dup_possible=True,
+        torn_possible=True,
+    )
+    assert (both3.dup_off, both3.torn_off, both3.total_words) == (10, 18, 19)
+    base2 = _v2_layout()
+    torn2 = layout_for(
+        RNG_STREAM_LEGACY, 4, 4, loss_possible=False, spike_possible=False,
+        delay_enabled=False, restart_possible=True, torn_possible=True,
+    )
+    assert (torn2.lat_off, torn2.drop_off) == (base2.lat_off, base2.drop_off)
+    assert torn2.torn_off == base2.total_words == 12
+    assert torn2.total_words == 13
+    both2 = layout_for(
+        RNG_STREAM_LEGACY, 4, 4, loss_possible=False, spike_possible=False,
+        delay_enabled=False, restart_possible=True, dup_possible=True,
+        torn_possible=True,
+    )
+    assert (both2.dup_off, both2.torn_off, both2.total_words) == (12, 20, 21)
+
+
+def test_v3_torn_step_words_pinned():
+    layout = layout_for(
+        RNG_STREAM_COUNTER, 4, 4, loss_possible=False, spike_possible=False,
+        delay_enabled=False, restart_possible=True, torn_possible=True,
+    )
+    for seed, expect in V3_TORN_WORDS.items():
+        key = _lane_key(seed)
+        for step in range(2):
+            _k, words, k_restart = step_words_v3(key, jnp.int32(step), layout)
+            assert words.tolist() == expect[step], (seed, step)
+            # restart key still reads from offset 8 — torn is pure tail
+            assert k_restart.tolist() == words[8:10].tolist()
+
+
+def test_v2_torn_prefix_is_the_legacy_stream():
+    """v2 + torn: the first 12 words of the 13-word block are bit-exactly
+    the pinned legacy block and the restart key is untouched — recorded
+    v2 seeds cannot notice the torn section existing."""
+    layout = layout_for(
+        RNG_STREAM_LEGACY, 4, 4, loss_possible=False, spike_possible=False,
+        delay_enabled=False, restart_possible=True, torn_possible=True,
+    )
+    for seed, tails in V2_TORN_TAIL.items():
+        key = _lane_key(seed)
+        for step in range(2):
+            key, words, k_restart = step_words(key, jnp.int32(step), layout)
+            assert words.tolist()[:12] == V2_WORDS[seed][step], (seed, step)
+            assert int(words[12]) == tails[step], (seed, step)
+            assert k_restart.tolist() == V2_K_RESTART[seed][step], (seed, step)
+
+
+def test_storage_kind_fault_schedules_pinned():
+    """The torn/heal-asym derivation (one extra per-fault draw + the
+    heal-asym third slot) is pinned: the mixed-vocabulary schedule (note
+    the third slot is VALID only for heal-asym faults), plus torn-only
+    rows (arg2 = the damage mask on both apply and undo) and
+    heal-asym-only rows (op 18 both-way clog, then op 19 heals a->b and
+    b->a at independently drawn times). V1/V2/WINDOW schedules passing
+    above is the proof the extra draw and slot are invisible with the
+    storage kinds off."""
+    eng = Engine(
+        RaftMachine(num_nodes=5, log_capacity=8),
+        EngineConfig(
+            horizon_us=5_000_000, queue_capacity=32, faults=STORAGE_FAULTS
+        ),
+    )
+    for seed, expect in STORAGE_SCHED.items():
+        s = eng.init_lane(seed)
+        rows = slice(5, 5 + 3 * STORAGE_FAULTS.n_faults)
+        assert s.eq_time[rows].tolist() == expect["time"], seed
+        assert s.eq_seq[rows].tolist() == expect["seq"], seed
+        assert s.eq_node[rows].tolist() == expect["node"], seed
+        assert s.eq_valid[rows].tolist() == expect["valid"], seed
+        assert s.eq_payload[rows].tolist() == expect["pay"], seed
+    single = dict(
+        n_faults=1, allow_partition=False, allow_kill=False,
+        t_min_us=200_000, t_max_us=600_000,
+        dur_min_us=200_000, dur_max_us=400_000,
+    )
+    for kind_flags, nrows, expect in (
+        (dict(allow_torn=True), 2, TORN_ONLY_ROWS_7),
+        (dict(allow_heal_asym=True), 3, HASYM_ONLY_ROWS_7),
+    ):
+        eng = Engine(
+            RaftMachine(num_nodes=5, log_capacity=8),
+            EngineConfig(
+                horizon_us=2_000_000, queue_capacity=32,
+                faults=FaultPlan(**single, **kind_flags),
+            ),
+        )
+        s = eng.init_lane(7)
+        rows = slice(5, 5 + nrows)
+        assert s.eq_time[rows].tolist() == expect["time"], kind_flags
+        assert s.eq_node[rows].tolist() == expect["node"], kind_flags
+        assert s.eq_valid[rows].tolist() == expect["valid"], kind_flags
         assert s.eq_payload[rows].tolist() == expect["pay"], kind_flags
 
 

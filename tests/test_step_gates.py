@@ -221,20 +221,25 @@ def test_strict_restart_gate_bit_identical():
 
 
 def test_new_chaos_kinds_live_and_observable():
-    """The whole PR-5 palette on at once (pause + skew + dup +
-    strict_restart, on top of FULL_CHAOS) with recorder + coverage:
-    every new capability must show nonzero injection counters AND
-    nonzero coverage in its own 4-bit-layout band — the 'is this chaos
-    actually reachable' assertion. One engine covers all four (tier-1
-    compile budget)."""
+    """The whole 11-kind palette on at once (PR-5 pause + skew + dup +
+    strict_restart and PR-6 torn + heal-asym, on top of FULL_CHAOS)
+    with recorder + coverage: every new capability must show nonzero
+    injection counters AND nonzero coverage in its own 4-bit-layout
+    band — the 'is this chaos actually reachable' assertion. One engine
+    covers all six (tier-1 compile budget); raft's durable_spec with no
+    torn_spec means torn restarts degrade to the amnesia wipe, so the
+    honest machine must also stay conviction-free."""
     import numpy as np
 
-    from madsim_tpu.engine.core import K_PAUSE, K_SKEW
+    from madsim_tpu.engine.core import K_HEAL_ASYM, K_PAUSE, K_SKEW, K_TORN
     from madsim_tpu.runtime.coverage import coverage_dict, unpack_map
 
     cfg = dataclasses.replace(
         FULL_CHAOS,
         rng_stream=3,
+        # headroom for pause-window deferral pressure: deliveries to a
+        # paused node park in their slots until resume
+        queue_capacity=96,
         flight_recorder=True,
         fr_digest_every=64,
         fr_digest_ring=4,
@@ -245,21 +250,25 @@ def test_new_chaos_kinds_live_and_observable():
             allow_pause=True,
             allow_skew=True,
             allow_dup=True,
+            allow_torn=True,
+            allow_heal_asym=True,
             strict_restart=True,
         ),
     )
     eng = Engine(_machine(), cfg)
     assert eng.cov_band_bits == 4
     res = _run(eng, n=48, max_steps=1200)
+    assert not bool(res.failed.any()), set(res.fail_code.tolist())
     inj = res.fr["inj"].sum(axis=0).tolist()
     assert inj[K_PAUSE] > 0 and inj[K_SKEW] > 0, inj
+    assert inj[K_TORN] > 0 and inj[K_HEAL_ASYM] > 0, inj
     assert int(res.fr["dup"].sum()) > 0
     assert int(res.fr["amnesia"].sum()) > 0
     m = unpack_map(
         np.bitwise_or.reduce(np.asarray(res.cov["map"]), axis=0), 12
     )
     bands = coverage_dict(m, 12, band_bits=4)["by_band"]
-    for band in ("pause", "skew", "dup", "amnesia"):
+    for band in ("pause", "skew", "dup", "amnesia", "torn", "heal_asym"):
         assert bands[band] > 0, (band, bands)
 
 
