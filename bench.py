@@ -156,6 +156,13 @@ def main() -> None:
     # within 5% of the r08 capture; the vs_r08 field below is the
     # receipt). =0 for an A/B.
     coverage = os.environ.get("MADSIM_TPU_COVERAGE", "1") not in ("", "0")
+    # Causal provenance (PR-7 observability gate): default OFF in the
+    # flagship capture — the r09 budget receipt (recorder+coverage ON)
+    # stays the comparable configuration. MADSIM_TPU_PROVENANCE=1 turns
+    # it on for an A/B; with MADSIM_TPU_BENCH_STEP_COST=1 the breakdown
+    # then carries a `provenance_off` line (acceptance: the lineage
+    # dataflow costs <= 5% of the step).
+    provenance = os.environ.get("MADSIM_TPU_PROVENANCE", "0") not in ("", "0")
     cfg = EngineConfig(
         horizon_us=5_000_000,
         # 32 slots: the real-chip queue sweep (PROFILE_r2.md) — the [L, Q]
@@ -168,6 +175,7 @@ def main() -> None:
         clog_packed=clog_packed,
         flight_recorder=flight_recorder,
         coverage=coverage,
+        provenance=provenance,
     )
     eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
 
@@ -245,6 +253,10 @@ def main() -> None:
             step_cost["coverage_off"] = one_rate(
                 Engine(eng.machine, dataclasses.replace(cfg, coverage=False))
             )
+        if cfg.provenance:
+            step_cost["provenance_off"] = one_rate(
+                Engine(eng.machine, dataclasses.replace(cfg, provenance=False))
+            )
 
     # 5%-budget receipt vs the r08 flagship capture (recorder + coverage
     # ON — the PR-4 observability-era baseline; the PR-5 chaos kinds are
@@ -300,6 +312,7 @@ def main() -> None:
                     "pallas_pop": eng.use_pallas_pop,
                     "flight_recorder": cfg.flight_recorder,
                     "coverage": cfg.coverage,
+                    "provenance": cfg.provenance,
                     "compile_cache": active_compile_cache(),
                 },
                 "diagnostics": {

@@ -150,6 +150,7 @@ def _build_engine(args):
         rng_stream=getattr(args, "rng_stream", 2),
         flight_recorder=bool(getattr(args, "flight_recorder", False)),
         coverage=bool(getattr(args, "coverage", False)),
+        provenance=bool(getattr(args, "provenance", False)),
         compile_cache_dir=getattr(args, "compile_cache", None),
         faults=FaultPlan(
             n_faults=args.faults,
@@ -285,6 +286,16 @@ def _print_cov_stats(stats) -> None:
     )
 
 
+def _print_attribution(stats) -> None:
+    """One fault-attribution line when provenance rode the run: how many
+    failures causally implicate each chaos kind."""
+    att = stats.get("fault_attribution")
+    if att is None:
+        return
+    kinds = ", ".join(f"{k}={v}" for k, v in att.items())
+    print(f"fault attribution: [{kinds or 'no failures'}]")
+
+
 def _stream_batches(eng, args, purpose="explore"):
     """Chunked streaming driver shared by explore/hunt: run the seed
     budget as batches of `--batch` seeds (each one run_stream call), so
@@ -325,6 +336,9 @@ def _stream_batches(eng, args, purpose="explore"):
         "abandoned": [],
         "seeds_consumed": 0,
         "stats": {},
+        # seed -> violation provenance word (--provenance; stays empty
+        # otherwise)
+        "provenance": {},
     }
     cov_map = None
     cursor = args.seed
@@ -351,6 +365,9 @@ def _stream_batches(eng, args, purpose="explore"):
             agg["failing"] = [tuple(x) for x in ck["failing"]]
             agg["infra"] = [tuple(x) for x in ck["infra"]]
             agg["abandoned"] = list(ck["abandoned"])
+            agg["provenance"] = {
+                int(k): int(v) for k, v in (ck.get("prov") or {}).items()
+            }
             cursor = int(ck["cursor"])
             start_bi = int(ck["batch"])
             plateaued = bool(ck.get("plateau", False))
@@ -395,6 +412,7 @@ def _stream_batches(eng, args, purpose="explore"):
                 "failing": [list(x) for x in agg["failing"]],
                 "infra": [list(x) for x in agg["infra"]],
                 "abandoned": list(agg["abandoned"]),
+                "prov": {str(k): v for k, v in agg["provenance"].items()},
                 "cov_b64": encode_map(cov_map) if cov_map is not None else None,
                 "detector": (
                     {
@@ -431,6 +449,7 @@ def _stream_batches(eng, args, purpose="explore"):
         agg["failing"].extend(out["failing"])
         agg["infra"].extend(out["infra"])
         agg["abandoned"].extend(out["abandoned"])
+        agg["provenance"].update(out.get("provenance", {}))
         agg["stats"] = out["stats"]
         new_slots = 0
         slots_hit = 0
@@ -510,6 +529,14 @@ def _stream_batches(eng, args, purpose="explore"):
             "plateau": plateaued,
             "plateau_patience": plateau_n,
         }
+    if agg["provenance"]:
+        # per-kind fault attribution over the finds: how many failures
+        # causally implicate each chaos kind — the machine-readable
+        # "why" marginal the stats JSONL and `/stats` service expose
+        from .engine.provenance import kind_counts
+
+        agg["stats"] = dict(agg["stats"])
+        agg["stats"]["fault_attribution"] = kind_counts(eng, agg["provenance"])
     if emitter is not None:
         emitter.emit(
             {
@@ -526,6 +553,10 @@ def _stream_batches(eng, args, purpose="explore"):
                 **(
                     {"coverage": agg["stats"]["coverage"]}
                     if cov_map is not None else {}
+                ),
+                **(
+                    {"fault_attribution": agg["stats"]["fault_attribution"]}
+                    if "fault_attribution" in agg["stats"] else {}
                 ),
             }
         )
@@ -595,7 +626,19 @@ def _find_failing(eng, args, purpose="hunt"):
             eng.failing_seeds(res).tolist(), res.fail_code[res.failed].tolist()
         )
     )
-    return failing, infra, 0, {"stats": {}}
+    agg = {"stats": {}, "provenance": {}}
+    if eng.config.provenance:
+        agg["provenance"] = {
+            int(s): int(p)
+            for s, p in zip(
+                eng.failing_seeds(res).tolist(),
+                res.fail_prov[res.failed].tolist(),
+            )
+        }
+        from .engine.provenance import kind_counts
+
+        agg["stats"]["fault_attribution"] = kind_counts(eng, agg["provenance"])
+    return failing, infra, 0, agg
 
 
 def cmd_explore(args) -> int:
@@ -656,6 +699,7 @@ def cmd_explore(args) -> int:
         )
         _print_fr_stats(st)
         _print_cov_stats(st)
+        _print_attribution(st)
         if failing:
             codes = sorted({c for _s, c in failing})
             print(f"failure codes: {codes}")
@@ -723,6 +767,7 @@ def cmd_hunt(args) -> int:
     )
     _print_fr_stats(stream_stats)
     _print_cov_stats(stream_stats)
+    _print_attribution(stream_stats)
     _write_coverage_out(eng, args, agg)
     entries = corpus.load(args.corpus)
     known = {e.key for e in entries}
@@ -747,7 +792,13 @@ def cmd_hunt(args) -> int:
             print(f"  code {code}: {len(seeds_of)} seeds ({verb})")
     for seed, code in to_shrink:
         try:
-            sr = shrink(eng, seed, max_steps=args.max_steps)
+            # the device-harvested provenance word (when the gate rode
+            # the hunt) seeds the guided candidate order; shrink still
+            # verifies every candidate by honest replay
+            sr = shrink(
+                eng, seed, max_steps=args.max_steps,
+                prov_word=agg.get("provenance", {}).get(seed),
+            )
         except ValueError as exc:
             # device-flagged but not reproducing on the host replay —
             # report it (that drift is itself a finding) and keep going
@@ -856,15 +907,29 @@ def cmd_trace(args) -> int:
     if not args.perfetto and not args.jsonl:
         sys.exit("trace needs at least one of --perfetto PATH / --jsonl PATH")
     eng = _build_engine(args)
-    rp = replay(eng, args.seed, max_steps=args.max_steps)
     n_nodes = eng.machine.NUM_NODES
     if args.perfetto:
+        # lineage-capturing replay: the queue sequence numbers plus the
+        # per-step push watermarks reconstruct every send->delivery
+        # edge, so the export draws flow arrows (works with the
+        # provenance gate off — message causality is free)
+        from .engine.provenance import replay_with_lineage
+
+        rp, lineage = replay_with_lineage(eng, args.seed, max_steps=args.max_steps)
+        flows = [
+            (lineage.trace[i], lineage.trace[j])
+            for i, j in lineage.message_flows()
+        ]
         n = write_perfetto(
             args.perfetto, rp.trace,
             machine=args.machine, seed=args.seed, num_nodes=n_nodes,
+            flows=flows,
         )
-        print(f"wrote {n} events to {args.perfetto} (perfetto trace_event; "
+        print(f"wrote {n} events ({len(flows)} message flows) to "
+              f"{args.perfetto} (perfetto trace_event; "
               f"open in https://ui.perfetto.dev)")
+    else:
+        rp = replay(eng, args.seed, max_steps=args.max_steps)
     if args.jsonl:
         n = write_jsonl(args.jsonl, rp.trace, machine=args.machine, seed=args.seed)
         print(f"wrote {n} events to {args.jsonl} (JSONL)")
@@ -872,6 +937,87 @@ def cmd_trace(args) -> int:
     print(f"seed {args.seed}: {status}, {len(rp.trace)} events, "
           f"t={int(rp.state.now_us)}us")
     return 1 if rp.failed else 0
+
+
+def cmd_why(args) -> int:
+    """Answer "why did this seed fail?": replay with causal provenance +
+    lineage reconstruction, decode the violation's provenance word to
+    the implicated scheduled faults (kind, virtual time, target), cut
+    the trace to the violation's past cone, and render the causal chain
+    as text (stdout / --out), machine-readable JSON (--json), and a
+    Perfetto timeline with flow arrows + the cone highlighted
+    (--perfetto)."""
+    from .engine.provenance import implicated, render_why, replay_with_lineage
+    from .engine.trace_export import write_perfetto
+
+    args.provenance = True  # the whole point of `why`
+    if getattr(args, "seed_pos", None) is not None:
+        args.seed = args.seed_pos
+    eng = _build_engine(args)
+    rp, lineage = replay_with_lineage(eng, args.seed, max_steps=args.max_steps)
+    if not rp.failed:
+        print(
+            f"seed {args.seed} does not fail under this config (within "
+            f"{args.max_steps} steps) — nothing to explain; pass the "
+            f"repro line's exact flags"
+        )
+        return 2
+    word = int(rp.state.fail_prov)
+    att = implicated(eng, args.seed, word)
+    cone = lineage.past_cone(len(lineage.trace) - 1)
+    text = render_why(
+        eng, args.seed, rp, lineage, cone, att, max_events=args.tail
+    )
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"causal chain -> {args.out}")
+    if args.json:
+        doc = {
+            "machine": args.machine,
+            "seed": args.seed,
+            "fail_code": rp.fail_code,
+            "fail_time_us": int(rp.state.now_us),
+            "prov_word": word,
+            "implicated_kinds": list(att.kinds),
+            "implicated_faults": [
+                {
+                    "index": f.index,
+                    "kind": f.kind_name,
+                    "t_apply_us": f.t_apply_us,
+                    "t_undo_us": f.t_undo_us,
+                    "target": f.target,
+                }
+                for f in att.faults
+            ],
+            "cone_events": len(cone),
+            "trace_events": len(lineage.trace),
+        }
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"attribution JSON -> {args.json}")
+    if args.perfetto:
+        cone_idx = set(cone)
+        cone_steps = {lineage.trace[i].step for i in cone}
+        flows = [
+            (lineage.trace[i], lineage.trace[j])
+            for i, j in lineage.message_flows()
+            if j in cone_idx
+        ]
+        n = write_perfetto(
+            args.perfetto, rp.trace,
+            machine=args.machine, seed=args.seed,
+            num_nodes=eng.machine.NUM_NODES,
+            flows=flows, highlight=cone_steps,
+        )
+        print(
+            f"wrote {n} events ({len(flows)} causal flows, cone "
+            f"highlighted) to {args.perfetto} (open in "
+            f"https://ui.perfetto.dev)"
+        )
+    return 0
 
 
 def cmd_audit(args) -> int:
@@ -1279,6 +1425,15 @@ def main(argv=None) -> int:
             "and `coverage` reports)",
         )
         p.add_argument(
+            "--provenance", action="store_true",
+            help="causal provenance: every queued event and node "
+            "carries a 32-bit lineage word (one bit per scheduled "
+            "fault, ORed along deliveries); failures decode to the "
+            "implicated faults in hunt reports, shrink uses attribution "
+            "to order its candidates, and `why` renders the causal "
+            "chain (results are bit-identical either way)",
+        )
+        p.add_argument(
             "--stats", default=None, metavar="BASE",
             help="StatsEmitter base path (also $MADSIM_TPU_STATS): "
             "stream per-batch stats to BASE.jsonl + Prometheus textfile "
@@ -1369,6 +1524,38 @@ def main(argv=None) -> int:
     p = sub.add_parser("shrink", help="minimize a failing seed's config")
     common(p)
     p.set_defaults(fn=cmd_shrink)
+
+    p = sub.add_parser(
+        "why",
+        help="explain a failing seed: replay with causal provenance, "
+        "name the implicated faults (kind, time, target), and render "
+        "the violation's past cone as text / JSON / Perfetto flows",
+    )
+    common(p)
+    p.add_argument(
+        "seed_pos", nargs="?", type=int, default=None, metavar="SEED",
+        help="the failing seed (equivalent to --seed; pass the repro "
+        "line's remaining flags so the schedule matches)",
+    )
+    p.add_argument(
+        "--tail", type=int, default=30,
+        help="cone events to print (0 = the whole cone)",
+    )
+    p.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="also write the rendered causal chain to PATH",
+    )
+    p.add_argument(
+        "--json", default=None, metavar="PATH",
+        help="write machine-readable attribution JSON "
+        "(implicated kinds/faults, prov word, cone size)",
+    )
+    p.add_argument(
+        "--perfetto", default=None, metavar="PATH",
+        help="write the timeline with causal flow arrows and the past "
+        "cone highlighted (args.cone=true; open in ui.perfetto.dev)",
+    )
+    p.set_defaults(fn=cmd_why)
 
     p = sub.add_parser(
         "hunt", help="explore + shrink + record failing seeds in the corpus"

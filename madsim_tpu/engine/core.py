@@ -160,6 +160,32 @@ FAULT_KIND_NAMES = (
     "torn", "heal-asym",
 )
 
+# -- causal provenance (observability) ---------------------------------------
+# One uint32 word per queued event and per node (`EngineConfig.
+# provenance`): bit f marks "scheduled fault f is in this value's causal
+# past". Provenance is MONOTONE — words only OR, never clear — so a
+# violation's word names every scheduled fault whose effects reached the
+# violating node through any chain of deliveries (an over-approximation
+# of the true cause set, never an under-approximation for fault effects
+# that flow through state and messages; what it cannot see is
+# absence-causality refinement — a clogged link's bit is planted on both
+# endpoints at clog time rather than on each message the clog swallowed).
+# Bits 30/31 are reserved for the two non-scheduled chaos channels, so
+# attribution can name them even though they own no schedule slot:
+# a crash-with-amnesia wipe (strict_restart) and a Bernoulli duplicate
+# delivery (allow_dup). Scheduled fault indices clip into the remaining
+# 30 bits (plans beyond 30 faults alias — attribution degrades to
+# coarser, still-sound-as-OR reporting, never to wrong dataflow).
+PROV_FAULT_BITS = 30
+PROV_BIT_AMNESIA = 30
+PROV_BIT_DUP = 31
+
+
+def prov_fault_bit(fault_index: int) -> int:
+    """The provenance bit a scheduled fault slot sets (python-level;
+    the schedule is unrolled statically in init_lane)."""
+    return 1 << min(fault_index, PROV_FAULT_BITS - 1)
+
 # Non-scheduled chaos injection counters (flight recorder): Bernoulli
 # message duplicates pushed, and strict (crash-with-amnesia) restarts
 # applied. They ride fr_metrics after the per-kind totals.
@@ -415,6 +441,23 @@ class EngineConfig:
     # result-identical — the map is write-only telemetry.
     coverage: bool = False
     cov_slots_log2: int = COV_SLOTS_LOG2_DEFAULT
+    # Causal provenance (observability): every queued event and every
+    # node carries a 32-bit provenance word — one bit per scheduled
+    # fault slot (bits 30/31: strict-restart wipes / duplicate
+    # deliveries), ORed along deliveries: a delivered message folds its
+    # lineage into the receiver, an injected fault plants its slot bit
+    # on the nodes it touches, timers and sends inherit their node's
+    # word. The violating lane's word is captured at the first invariant
+    # failure and rides the existing failure-ring harvest — zero extra
+    # host syncs, same discipline as recorder/coverage. Consumers:
+    # per-find fault attribution in run_stream/hunt reports,
+    # provenance-guided shrink (engine/shrink.py ablates non-implicated
+    # faults first), and `python -m madsim_tpu why` (engine/
+    # provenance.py decodes the word against the seed's re-derived
+    # fault schedule and cuts the replay trace to the violation's past
+    # cone). Consumes NO RNG words; gate-off is bit-identical (tests
+    # assert under both stream versions).
+    provenance: bool = False
     # Opt-in JAX persistent compilation cache directory (also
     # $MADSIM_TPU_COMPILE_CACHE): hunts and sweeps pay each multi-second
     # compile once per machine instead of once per process. Host-side
@@ -450,6 +493,14 @@ class LaneState:
     # a disabled kind carries — and computes — nothing)
     paused_until: jax.Array  # virtual us the node resumes at (0 = running)
     skew_q10: jax.Array  # active q10 timer-delay multiplier (0 = none)
+    # causal provenance (EngineConfig.provenance): uint32 lineage words —
+    # uint32[N] per node / uint32[Q] per queued event / uint32 scalar
+    # captured at the first invariant failure; uint32[0] when the gate
+    # is off (the leaves exist so the pytree structure is uniform, but a
+    # disabled gate carries — and computes — nothing)
+    node_prov: jax.Array
+    eq_prov: jax.Array
+    fail_prov: jax.Array
     nodes: Any
     ring: Any  # {} when trace_ring == 0, else dict of [R]/[R,P] arrays
     fr: Any  # {} unless flight_recorder: digest + checkpoint ring + metrics
@@ -471,6 +522,7 @@ class StreamCarry:
     segments: jax.Array  # int32 scalar — segments executed on device
     fail_seeds: jax.Array  # uint32[C]
     fail_codes: jax.Array  # int32[C]
+    fail_provs: jax.Array  # uint32[C] violation provenance words ([0] when off)
     fail_count: jax.Array  # int32 scalar
     ab_seeds: jax.Array  # uint32[C]
     ab_count: jax.Array  # int32 scalar
@@ -485,6 +537,7 @@ class BatchResult:
     done: jax.Array
     failed: jax.Array
     fail_code: jax.Array
+    fail_prov: jax.Array  # uint32[L] violation provenance words ([L, 0] when off)
     now_us: jax.Array
     steps: jax.Array
     msg_count: jax.Array
@@ -654,6 +707,9 @@ class Engine:
         eq_payload = jnp.zeros((q, p), jnp.int32)  # timer id BOOT == 0
         eq_valid = is_boot_slot
         next_seq = n
+        # provenance: boot timers are causal roots (word 0); each fault
+        # slot carries its fault's bit so processing the event plants it
+        eq_prov = jnp.zeros((q if cfg.provenance else 0,), jnp.uint32)
 
         # Fault schedule: apply + undo event per fault, slots [n, n+2F).
         fp = cfg.faults
@@ -790,6 +846,10 @@ class Engine:
                 pay = jnp.stack([op, p1, p2] + [jnp.int32(0)] * (p - 3))
                 eq_payload = jnp.where(msk[:, None], pay[None, :], eq_payload)
                 eq_valid = eq_valid | (msk if valid is None else (msk & valid))
+                if cfg.provenance:
+                    eq_prov = jnp.where(
+                        msk, jnp.uint32(prov_fault_bit(f)), eq_prov
+                    )
             next_seq += fp.slots_per_fault
 
         return LaneState(
@@ -819,6 +879,11 @@ class Engine:
             killed=jnp.zeros((n,), bool),
             paused_until=jnp.zeros((n if fp.allow_pause else 0,), jnp.int32),
             skew_q10=jnp.zeros((n if fp.allow_skew else 0,), jnp.int32),
+            node_prov=jnp.zeros((n if cfg.provenance else 0,), jnp.uint32),
+            eq_prov=eq_prov,
+            fail_prov=(
+                jnp.uint32(0) if cfg.provenance else jnp.zeros((0,), jnp.uint32)
+            ),
             nodes=nodes,
             ring=self._empty_ring(),
             fr=self._empty_fr(),
@@ -907,6 +972,11 @@ class Engine:
             ev_payload = s.eq_payload[idx]
         else:
             ev_time, ev_kind, ev_node, ev_src, ev_payload = popped
+
+        if cfg.provenance:
+            # the popped event's lineage word (fault slots carry their
+            # bit from init; messages/timers carry their sender's word)
+            ev_prov = s.eq_prov[idx]
 
         new_now = jnp.maximum(s.now_us, ev_time)
         hz = cfg.horizon_us if horizon_us is None else horizon_us
@@ -1135,6 +1205,57 @@ class Engine:
         outbox_valid_msgs = outbox.msg_valid & effective
         outbox_valid_timers = outbox.timer_valid & effective
 
+        # -- causal provenance fold (gate-off adds NO ops) ------------------
+        # A processed handler event folds its lineage into the handling
+        # node; a processed fault event plants its word on the nodes it
+        # touches — both endpoints for pair/dir/heal ops, node `a` for
+        # node ops (kill/restart/pause/skew/torn), every node for the
+        # global window/group ops (a loss storm touches every link; the
+        # over-approximation is the documented contract). Everything the
+        # node emits afterwards (messages, timers, the restart boot)
+        # inherits the node's updated word.
+        if cfg.provenance:
+            nn_p = s.killed.shape[0]
+            idxs_p = jnp.arange(nn_p)
+            p_op = ev_payload[0]
+            is_fault_ev = ev_kind == EV_FAULT
+            prov_pair_ops = (
+                (p_op == F_CLOG_PAIR) | (p_op == F_UNCLOG_PAIR)
+                | (p_op == F_CLOG_DIR) | (p_op == F_UNCLOG_DIR)
+            )
+            if cfg.faults.allow_heal_asym:
+                prov_pair_ops = prov_pair_ops | (p_op == F_HASYM) | (p_op == F_HASYM_HEAL)
+            prov_global_ops = (
+                (p_op == F_CLOG_GROUP) | (p_op == F_UNCLOG_GROUP)
+                | (p_op == F_LOSS_STORM) | (p_op == F_LOSS_END)
+                | (p_op == F_DELAY_SPIKE) | (p_op == F_DELAY_END)
+            )
+            touched = jnp.where(
+                is_fault_ev,
+                prov_global_ops
+                | (prov_pair_ops & ((idxs_p == ev_payload[1]) | (idxs_p == ev_payload[2])))
+                | (~prov_global_ops & ~prov_pair_ops & (idxs_p == ev_payload[1])),
+                idxs_p == ev_node,
+            )
+            add_word = ev_prov
+            if cfg.faults.strict_restart:
+                # a crash-with-amnesia wipe is its own attribution
+                # channel (bit 30): it has no schedule slot of its own
+                add_word = jnp.where(
+                    is_fault_ev & (p_op == F_RESTART),
+                    ev_prov | jnp.uint32(1 << PROV_BIT_AMNESIA),
+                    ev_prov,
+                )
+            node_prov = jnp.where(
+                touched & effective, s.node_prov | add_word, s.node_prov
+            )
+            # the word every push below inherits (fault events push only
+            # the restart boot timer, whose node is ev_node == a)
+            sender_prov = node_prov[ev_node]
+        else:
+            node_prov = s.node_prov
+            sender_prov = None
+
         # -- push outbox messages with chaos (latency / loss / clog) --------
         eq = {
             "time": s.eq_time,
@@ -1145,6 +1266,8 @@ class Engine:
             "payload": s.eq_payload,
             "valid": eq_valid,
         }
+        if cfg.provenance:
+            eq["prov"] = s.eq_prov
         if defer is not None:
             # deferred delivery: rewrite the (still-valid) popped slot's
             # time to the node's resume point. Seq is untouched — at the
@@ -1155,6 +1278,14 @@ class Engine:
             # deferral can never overflow the queue.
             defer_slot = (jnp.arange(s.eq_valid.shape[0]) == idx) & defer
             eq["time"] = jnp.where(defer_slot, node_resume_us, eq["time"])
+            if cfg.provenance:
+                # the deferral is caused by the pause window: the target
+                # node's word (which carries the pause fault's bit since
+                # the F_PAUSE apply touched it) folds into the deferred
+                # event's lineage
+                eq["prov"] = jnp.where(
+                    defer_slot, eq["prov"] | s.node_prov[ev_node], eq["prov"]
+                )
         next_seq = s.next_seq
         failed = s.failed
         fail_code = s.fail_code
@@ -1228,7 +1359,10 @@ class Engine:
             failed = failed | overflow
             fail_code = jnp.where(overflow, jnp.int32(OVERFLOW), fail_code)
             do_push = do_push & has_free
-            eq = _push(eq, slot, do_push, new_now + latency, next_seq, EV_MSG, dst, ev_node, outbox.msg_payload[mi])
+            eq = _push(
+                eq, slot, do_push, new_now + latency, next_seq, EV_MSG, dst,
+                ev_node, outbox.msg_payload[mi], prov=sender_prov,
+            )
             next_seq = next_seq + jnp.where(do_push, 1, 0)
             msg_count = msg_count + jnp.where(do_push, 1, 0)
             if layout.dup_active:
@@ -1248,6 +1382,12 @@ class Engine:
                 eq = _push(
                     eq, dslot, want_dup, new_now + dup_latency, next_seq,
                     EV_MSG, dst, ev_node, outbox.msg_payload[mi],
+                    # the duplicate copy carries the dup attribution bit:
+                    # a violation whose lineage includes it names `dup`
+                    prov=(
+                        sender_prov | jnp.uint32(1 << PROV_BIT_DUP)
+                        if sender_prov is not None else None
+                    ),
                 )
                 next_seq = next_seq + jnp.where(want_dup, 1, 0)
                 msg_count = msg_count + jnp.where(want_dup, 1, 0)
@@ -1278,7 +1418,7 @@ class Engine:
                 )
             eq = _push(
                 eq, slot, want, new_now + t_delay, next_seq,
-                EV_TIMER, ev_node, jnp.int32(-1), tpay,
+                EV_TIMER, ev_node, jnp.int32(-1), tpay, prov=sender_prov,
             )
             next_seq = next_seq + jnp.where(want, 1, 0)
 
@@ -1290,7 +1430,10 @@ class Engine:
         fail_code = jnp.where(boot_overflow, jnp.int32(OVERFLOW), fail_code)
         want_boot = want_boot & has_free
         boot_pay = jnp.zeros((m.PAYLOAD_WIDTH,), jnp.int32)  # BOOT == 0
-        eq = _push(eq, slot, want_boot, new_now, next_seq, EV_TIMER, boot_node, jnp.int32(-1), boot_pay)
+        eq = _push(
+            eq, slot, want_boot, new_now, next_seq, EV_TIMER, boot_node,
+            jnp.int32(-1), boot_pay, prov=sender_prov,
+        )
         next_seq = next_seq + jnp.where(want_boot, 1, 0)
 
         # -- flight recorder (observability; gate-off adds NO ops) ----------
@@ -1421,6 +1564,17 @@ class Engine:
         # -- invariants / termination ---------------------------------------
         ok, code = m.invariant(nodes, new_now)
         inv_fail = process & ~ok
+        if cfg.provenance:
+            # the violation's provenance: the handling node's lineage
+            # cone at the step whose transition broke the invariant
+            # (its word already folds the popped event's). Captured at
+            # the FIRST failure only — that is the violation the fail
+            # code names.
+            fail_prov = jnp.where(
+                inv_fail & ~s.failed, sender_prov | ev_prov, s.fail_prov
+            )
+        else:
+            fail_prov = s.fail_prov
         failed = failed | inv_fail
         fail_code = jnp.where(inv_fail, code, fail_code)
         if active is None:
@@ -1456,6 +1610,9 @@ class Engine:
             killed=killed,
             paused_until=paused_until,
             skew_q10=skew_q10,
+            node_prov=node_prov,
+            eq_prov=eq.get("prov", s.eq_prov),
+            fail_prov=fail_prov,
             nodes=nodes,
             ring=ring,
             fr=fr,
@@ -1505,6 +1662,7 @@ class Engine:
             done=final.done,
             failed=final.failed,
             fail_code=final.fail_code,
+            fail_prov=final.fail_prov,
             now_us=final.now_us,
             steps=final.step,
             msg_count=final.msg_count,
@@ -1616,6 +1774,9 @@ class Engine:
                 segments=jnp.int32(0),
                 fail_seeds=jnp.zeros((cap,), jnp.uint32),
                 fail_codes=jnp.zeros((cap,), jnp.int32),
+                fail_provs=jnp.zeros(
+                    (cap if self.config.provenance else 0,), jnp.uint32
+                ),
                 fail_count=jnp.int32(0),
                 ab_seeds=jnp.zeros((cap,), jnp.uint32),
                 ab_count=jnp.int32(0),
@@ -1666,6 +1827,14 @@ class Engine:
             fail_codes, _ = _append_ring(
                 c.fail_codes, c.fail_count, fail_mask, state.fail_code
             )
+            # violation provenance words ride the same failure ring —
+            # harvested with the seeds/codes at the existing drain, zero
+            # extra steady-state syncs
+            fail_provs = c.fail_provs
+            if self.config.provenance:
+                fail_provs, _ = _append_ring(
+                    c.fail_provs, c.fail_count, fail_mask, state.fail_prov
+                )
             ab_mask = done & ~state.failed & over_cap
             ab_seeds, ab_count = _append_ring(c.ab_seeds, c.ab_count, ab_mask, seeds)
 
@@ -1719,6 +1888,7 @@ class Engine:
                 segments=c.segments + 1,
                 fail_seeds=fail_seeds,
                 fail_codes=fail_codes,
+                fail_provs=fail_provs,
                 fail_count=fail_count,
                 ab_seeds=ab_seeds,
                 ab_count=ab_count,
@@ -1812,7 +1982,11 @@ class Engine:
         (slots_hit / slots_total / fraction / by_band / curve — the
         (completed, slots_hit) pair at every poll) and the result dict a
         "coverage_map" bool array (the global OR of lane maps, the
-        artifact `hunt --coverage-out` persists).
+        artifact `hunt --coverage-out` persists). With
+        `config.provenance`, the result dict gains "provenance"
+        {seed: violation provenance word} for every drained failing
+        lane (engine/provenance.py decodes the words to implicated
+        faults).
         """
         import numpy as np
 
@@ -1841,6 +2015,9 @@ class Engine:
         failing: list = []
         infra: list = []
         abandoned: list = []
+        # seed -> violation provenance word (EngineConfig.provenance):
+        # filled at the same ring drains that surface the seeds
+        prov_by_seed: dict = {}
         stats = {"host_syncs": 0, "drains": 0, "dispatches": 0,
                  "dispatch_retries": 0}
         # (completed, slots_hit) at every blocking poll: the live
@@ -1872,20 +2049,25 @@ class Engine:
             )
 
         def drain(c: StreamCarry) -> StreamCarry:
-            f_seeds, f_codes, f_n, a_seeds, a_n = _dispatch(
+            f_seeds, f_codes, f_provs, f_n, a_seeds, a_n = _dispatch(
                 "ring drain",
                 jax.device_get,
-                (c.fail_seeds, c.fail_codes, c.fail_count, c.ab_seeds, c.ab_count),
+                (c.fail_seeds, c.fail_codes, c.fail_provs, c.fail_count,
+                 c.ab_seeds, c.ab_count),
             )
             stats["drains"] += 1
             stats["host_syncs"] += 1
-            for s, code in zip(f_seeds[: int(f_n)], f_codes[: int(f_n)]):
+            for i, (s, code) in enumerate(
+                zip(f_seeds[: int(f_n)], f_codes[: int(f_n)])
+            ):
                 # infra artifacts (fixed-shape overflow aborts) are kept
                 # out of the findings bucket: an OVERFLOW lane means
                 # "rerun with a bigger queue", not "protocol bug"
                 (infra if int(code) == OVERFLOW else failing).append(
                     (int(s), int(code))
                 )
+                if self.config.provenance:
+                    prov_by_seed[int(s)] = int(f_provs[i])
             abandoned.extend(int(s) for s in a_seeds[: int(a_n)])
             return reset_rings(c)
 
@@ -1993,6 +2175,8 @@ class Engine:
         }
         if cov_map_np is not None:
             out["coverage_map"] = cov_map_np
+        if self.config.provenance:
+            out["provenance"] = prov_by_seed
         return out
 
     def make_runner(self, max_steps: int = 10_000, mesh=None):
@@ -2096,14 +2280,17 @@ class Engine:
         return r1
 
 
-def _push(eq, idx, do_push, time, seq, kind, node, src, payload):
-    """Masked-select write of one event into slot `idx` (no scatters)."""
+def _push(eq, idx, do_push, time, seq, kind, node, src, payload, prov=None):
+    """Masked-select write of one event into slot `idx` (no scatters).
+    `prov`, when the provenance gate materializes the eq["prov"] plane,
+    is the pushed event's lineage word (the sender's word, plus the dup
+    bit for duplicate copies)."""
     m = (jnp.arange(eq["valid"].shape[0]) == idx) & do_push
 
     def upd(arr, value):
         return jnp.where(m, jnp.int32(value), arr)
 
-    return {
+    out = {
         "time": upd(eq["time"], time),
         "seq": upd(eq["seq"], seq),
         "kind": upd(eq["kind"], kind),
@@ -2112,3 +2299,8 @@ def _push(eq, idx, do_push, time, seq, kind, node, src, payload):
         "payload": jnp.where(m[:, None], payload[None, :], eq["payload"]),
         "valid": eq["valid"] | m,
     }
+    if "prov" in eq:
+        out["prov"] = (
+            jnp.where(m, prov, eq["prov"]) if prov is not None else eq["prov"]
+        )
+    return out

@@ -43,6 +43,9 @@ def config_to_dict(cfg: EngineConfig) -> dict:
     # asserted bit-identical — entries must replay with or without it
     for k in ("coverage", "cov_slots_log2"):
         d.pop(k, None)
+    # causal provenance too: lineage words never feed back into results,
+    # and `why` re-enables the gate itself at replay time
+    d.pop("provenance", None)
     return d
 
 

@@ -30,6 +30,17 @@ class TraceEvent:
     node: int
     src: int
     payload: tuple
+    # the event's queue sequence number — unique per lane, assigned at
+    # push time, so (together with the per-step next_seq watermarks) the
+    # host can reconstruct exactly which step enqueued which event: the
+    # send->delivery / arm->fire lineage engine/provenance.py and the
+    # Perfetto flow arrows are built from. -1 on traces recorded before
+    # the field existed.
+    seq: int = -1
+    # the event's causal-provenance word (EngineConfig.provenance;
+    # 0 when the gate is off): one bit per scheduled fault slot in the
+    # event's lineage, bits 30/31 = strict-restart wipe / dup delivery
+    prov: int = 0
 
     def __repr__(self) -> str:
         src = f" src={self.src}" if self.kind == "msg" else ""
@@ -150,6 +161,7 @@ def _trace_affecting_key(engine: Engine) -> tuple:
         cfg.faults.strict_restart,
         cfg.faults.allow_torn,
         cfg.faults.allow_heal_asym,
+        cfg.provenance,  # lineage words compiled into the step
         engine._rng_layout,  # stream version + word-block layout (incl. dup)
         engine.use_pallas_pop,
     )
@@ -232,6 +244,7 @@ def replay(
         step_fn = cache[skey]
         events: List[TraceEvent] = []
         step = 0
+        prov_on = engine.config.provenance
         while not bool(state.done | state.failed) and step < max_steps:
             idx, any_valid = pop_earliest(state.eq_time, state.eq_seq, state.eq_valid)
             ev = TraceEvent(
@@ -241,6 +254,8 @@ def replay(
                 node=int(state.eq_node[idx]),
                 src=int(state.eq_src[idx]),
                 payload=tuple(int(x) for x in state.eq_payload[idx]),
+                seq=int(state.eq_seq[idx]),
+                prov=int(state.eq_prov[idx]) if prov_on else 0,
             ) if bool(any_valid) else None
             state = step_fn(state)
             if ev is not None:

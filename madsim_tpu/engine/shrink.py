@@ -20,6 +20,14 @@ to a minimal one that still reproduces the same failure code:
 Every candidate is verified by an actual replay; the result reports only
 transformations that kept the SAME fail code. Exposed as
 `python -m madsim_tpu shrink --machine M --seed N ...`.
+
+With `EngineConfig.provenance` (or an explicit `prov_word`), the
+violation's causal-provenance word steers the candidate ORDER — the
+fault-count scan jumps straight to the smallest prefix containing every
+implicated fault, and the kind ablation bulk-drops the non-implicated
+kinds in one candidate — cutting replays on multi-fault finds while the
+verify-by-replay contract stays intact (attribution is an
+over-approximation and is never trusted, only used to order guesses).
 """
 
 from __future__ import annotations
@@ -61,6 +69,8 @@ class ShrinkResult:
     fail_time_us: int
     attempts: int           # replays spent shrinking
     kinds_removed: tuple = ()  # chaos flags ablated off (honest replays)
+    guided: bool = False       # provenance attribution steered the order
+    prov_kinds: tuple = ()     # kinds the violation's provenance implicated
 
     def summary(self) -> str:
         o, s = self.original, self.shrunk
@@ -74,10 +84,14 @@ class ShrinkResult:
         if s.horizon_us != o.horizon_us:
             parts.append(f"horizon {o.horizon_us}us -> {s.horizon_us}us")
         changed = "; ".join(parts) if parts else "config already minimal"
+        guided = (
+            f", provenance-guided by [{','.join(self.prov_kinds)}]"
+            if self.guided else ""
+        )
         return (
             f"seed {self.seed} fails with code {self.fail_code} in "
             f"{self.steps} events (t={self.fail_time_us}us); {changed} "
-            f"[{self.attempts} verification replays]"
+            f"[{self.attempts} verification replays{guided}]"
         )
 
 
@@ -88,8 +102,24 @@ def _fails_same(engine: Engine, seed: int, max_steps: int, code: int) -> Optiona
     return None
 
 
-def shrink(engine: Engine, seed: int, max_steps: int = 10_000) -> ShrinkResult:
+def shrink(
+    engine: Engine,
+    seed: int,
+    max_steps: int = 10_000,
+    prov_word: Optional[int] = None,
+) -> ShrinkResult:
     """Minimize the failing configuration for `seed`.
+
+    With a violation provenance word (`prov_word`, or for free from the
+    base replay when `engine.config.provenance` is on), attribution
+    steers the candidate ORDER: the fault-count scan first tries the
+    smallest prefix that still contains every implicated fault, and the
+    kind ablation first tries every NON-implicated kind off in one bulk
+    candidate — cutting the replay count on multi-fault finds. Guidance
+    never weakens the contract: every accepted candidate is still
+    verified by a full honest replay reproducing the same fail code
+    (attribution over-approximates, so a guided guess can fail — the
+    scan then falls back to the unguided order).
 
     Raises ValueError if the seed does not fail under the given engine.
     """
@@ -104,17 +134,51 @@ def shrink(engine: Engine, seed: int, max_steps: int = 10_000) -> ShrinkResult:
     cfg = engine.config
     best = base
 
+    # provenance attribution (when available): implicated fault indices
+    # + kind names — the candidate-ordering hints
+    if prov_word is None and engine.config.provenance:
+        prov_word = int(base.state.fail_prov)
+    att = None
+    if prov_word:
+        from .provenance import implicated
+
+        att = implicated(engine, seed, int(prov_word))
+    guided = att is not None
+    imp_kinds = set(att.kinds) if att else set()
+
     # 1. fewest faults whose prefix-plan still reproduces (linear scan from
-    #    zero: the minimal candidate first)
-    for f in range(cfg.faults.n_faults):
+    #    zero: the minimal candidate first). Guided: a prefix can only
+    #    reproduce if it contains the implicated faults, so try the
+    #    smallest such prefix FIRST — on a hit that is ONE replay where
+    #    the unguided scan pays max(implicated)+2; on a miss (attribution
+    #    over-approximated nothing away) fall back to the full scan.
+    def try_n_faults(f: int):
         cand_cfg = dataclasses.replace(
             cfg, faults=dataclasses.replace(cfg.faults, n_faults=f)
         )
-        attempts += 1
         rp = _fails_same(Engine(engine.machine, cand_cfg), seed, max_steps, code)
-        if rp is not None:
-            cfg, best = cand_cfg, rp
-            break
+        return cand_cfg, rp
+
+    guessed = False
+    tried_guess = None
+    if att and att.faults and not att.aliased:
+        guess = max(f.index for f in att.faults) + 1
+        if guess < cfg.faults.n_faults:
+            attempts += 1
+            tried_guess = guess
+            cand_cfg, rp = try_n_faults(guess)
+            if rp is not None:
+                cfg, best = cand_cfg, rp
+                guessed = True
+    if not guessed:
+        for f in range(cfg.faults.n_faults):
+            if f == tried_guess:
+                continue  # already replayed above
+            attempts += 1
+            cand_cfg, rp = try_n_faults(f)
+            if rp is not None:
+                cfg, best = cand_cfg, rp
+                break
 
     # 2. packet loss off
     if cfg.packet_loss_rate > 0:
@@ -129,8 +193,35 @@ def shrink(engine: Engine, seed: int, max_steps: int = 10_000) -> ShrinkResult:
     #    fail code; flags whose removal changes the outcome stay. A
     #    scheduled plan must keep at least one kind (the constructor
     #    rejects an empty vocabulary with n_faults > 0).
+    #    Guided: attribution names the implicated kinds, so first try
+    #    every NON-implicated kind off in ONE bulk candidate — on a hit
+    #    the per-kind scan then only visits the implicated kinds
+    #    (1 + |implicated| replays instead of |enabled|).
     kinds_removed = []
-    for kind_name, field in ABLATABLE_KINDS:
+    enabled = [
+        (name, field)
+        for name, field in ABLATABLE_KINDS
+        if getattr(cfg.faults, field)
+    ]
+    scan = enabled
+    if guided:
+        non_imp = [(n, f) for n, f in enabled if n not in imp_kinds]
+        if len(non_imp) >= 2:
+            bulk_faults = dataclasses.replace(
+                cfg.faults, **{f: False for _n, f in non_imp}
+            )
+            if bulk_faults.n_faults == 0 or bulk_faults.enabled_kinds():
+                cand_cfg = dataclasses.replace(cfg, faults=bulk_faults)
+                attempts += 1
+                rp = _fails_same(
+                    Engine(engine.machine, cand_cfg), seed, max_steps, code
+                )
+                if rp is not None:
+                    cfg, best = cand_cfg, rp
+                    kinds_removed.extend(n for n, _f in non_imp)
+                    # only the implicated kinds are left to try
+                    scan = [(n, f) for n, f in enabled if n in imp_kinds]
+    for kind_name, field in scan:
         if not getattr(cfg.faults, field):
             continue
         cand_faults = dataclasses.replace(cfg.faults, **{field: False})
@@ -164,4 +255,6 @@ def shrink(engine: Engine, seed: int, max_steps: int = 10_000) -> ShrinkResult:
         fail_time_us=int(best.state.now_us),
         attempts=attempts,
         kinds_removed=tuple(kinds_removed),
+        guided=guided,
+        prov_kinds=tuple(att.kinds) if att else (),
     )

@@ -4,9 +4,14 @@ A replayed seed's event trace is a virtual-time timeline: every popped
 event names the node that handled it and the virtual microsecond it ran
 at. The Chrome `trace_event` export maps that onto the profiler UI's
 native model — one process per simulated seed, one thread row per node,
-instant events at virtual timestamps — so `chrome://tracing` or
+1µs slices at virtual timestamps — so `chrome://tracing` or
 https://ui.perfetto.dev renders a seed's schedule (elections, message
 storms, fault windows) exactly like a CPU profile, scrubber and all.
+Message causality renders natively too: send→delivery pairs become flow
+arrows (`ph: s/f` bound to the 1µs slices — flows cannot bind to bare
+instants, which is why handler events are slices, not `ph: i` marks),
+and fault injections get globally-scoped instant markers named by fault
+kind so chaos windows are findable at a glance.
 
 The JSONL export is the machine-readable sibling: one JSON object per
 event, grep/jq-able, stable keys — the structured counterpart of
@@ -18,9 +23,20 @@ engine traces).
 from __future__ import annotations
 
 import json
-from typing import List, Optional
+from typing import List, Optional, Sequence, Set, Tuple
 
+from .core import FAULT_KIND_NAMES
 from .replay import TraceEvent
+
+# payload[0] of a fault event -> human name (apply ops are even, the
+# matching undo odd, op = 2*kind — engine/core.py's op numbering)
+def _fault_op_name(op: int) -> str:
+    kind = op // 2
+    name = (
+        FAULT_KIND_NAMES[kind] if 0 <= kind < len(FAULT_KIND_NAMES)
+        else f"op{op}"
+    )
+    return f"{name}{'+' if op % 2 == 0 else '-'}"
 
 
 def trace_event_dict(
@@ -29,10 +45,20 @@ def trace_event_dict(
     machine: str = "machine",
     seed: int = 0,
     num_nodes: Optional[int] = None,
+    flows: Optional[Sequence[Tuple[TraceEvent, TraceEvent]]] = None,
+    highlight: Optional[Set[int]] = None,
 ) -> dict:
     """Build the Chrome trace_event JSON object (dict) for one replayed
     seed. Timestamps are VIRTUAL microseconds (trace_event's native
-    unit, so the UI's time axis reads as simulation time directly)."""
+    unit, so the UI's time axis reads as simulation time directly).
+
+    `flows` are (send event, delivery event) pairs — each becomes a flow
+    arrow from the sender's slice to the delivery's slice
+    (engine/provenance.py's `Lineage.message_flows` computes them from
+    the queue sequence numbers, no provenance gate required).
+    `highlight` is a set of step numbers to tag with `"cone": true`
+    (the `why` CLI marks the violation's causal past so the cone is
+    filterable in the UI)."""
     pid = 0
     out: List[dict] = [
         {
@@ -71,23 +97,53 @@ def trace_event_dict(
         if ev.kind == "msg":
             name = f"msg<-{ev.src}"
         elif ev.kind == "fault":
-            name = f"fault op={ev.payload[0]}"
+            name = f"fault {_fault_op_name(ev.payload[0])}"
         elif ev.kind == "timer":
             name = f"timer id={ev.payload[0]}"
+        args = {
+            "step": ev.step,
+            "src": ev.src,
+            "payload": list(ev.payload),
+        }
+        if ev.seq >= 0:
+            args["seq"] = ev.seq
+        if ev.prov:
+            args["prov"] = f"0x{ev.prov & 0xFFFFFFFF:08x}"
+        if highlight is not None and ev.step in highlight:
+            args["cone"] = True
         out.append(
             {
-                "ph": "i",  # instant: handlers take zero virtual time
-                "s": "t",  # thread-scoped marker
+                "ph": "X",  # 1µs slice: flows can bind, instants cannot
+                "dur": 1,
                 "pid": pid,
                 "tid": ev.node,
                 "ts": ev.time_us,
                 "name": name,
-                "args": {
-                    "step": ev.step,
-                    "src": ev.src,
-                    "payload": list(ev.payload),
-                },
+                "args": args,
             }
+        )
+        if ev.kind == "fault":
+            # globally-scoped instant: fault injections draw a full-
+            # height marker so chaos windows are visible at any zoom
+            out.append(
+                {
+                    "ph": "i",
+                    "s": "g",
+                    "pid": pid,
+                    "tid": ev.node,
+                    "ts": ev.time_us,
+                    "name": f"inject {_fault_op_name(ev.payload[0])}",
+                    "args": {"step": ev.step},
+                }
+            )
+    for send, recv in flows or ():
+        fid = recv.seq if recv.seq >= 0 else (send.step << 16) | recv.step
+        common = {"pid": pid, "cat": "msg", "name": "send", "id": fid}
+        out.append(
+            {"ph": "s", "tid": send.node, "ts": send.time_us, **common}
+        )
+        out.append(
+            {"ph": "f", "bp": "e", "tid": recv.node, "ts": recv.time_us, **common}
         )
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
@@ -99,10 +155,15 @@ def write_perfetto(
     machine: str = "machine",
     seed: int = 0,
     num_nodes: Optional[int] = None,
+    flows: Optional[Sequence[Tuple[TraceEvent, TraceEvent]]] = None,
+    highlight: Optional[Set[int]] = None,
 ) -> int:
     """Write the Perfetto/Chrome trace_event JSON file. Returns the
     number of trace events written (excluding metadata records)."""
-    doc = trace_event_dict(events, machine=machine, seed=seed, num_nodes=num_nodes)
+    doc = trace_event_dict(
+        events, machine=machine, seed=seed, num_nodes=num_nodes,
+        flows=flows, highlight=highlight,
+    )
     with open(path, "w") as f:
         json.dump(doc, f)
         f.write("\n")
@@ -117,23 +178,25 @@ def write_jsonl(
     seed: int = 0,
 ) -> int:
     """Write one JSON object per trace event: {"machine", "seed",
-    "step", "t_us", "kind", "node", "src", "payload"}. Returns the
-    number of lines written."""
+    "step", "t_us", "kind", "node", "src", "payload"} plus "seq" (and
+    "prov" under the provenance gate). Returns the number of lines
+    written."""
     with open(path, "w") as f:
         for ev in events:
-            f.write(
-                json.dumps(
-                    {
-                        "machine": machine,
-                        "seed": seed,
-                        "step": ev.step,
-                        "t_us": ev.time_us,
-                        "kind": ev.kind,
-                        "node": ev.node,
-                        "src": ev.src,
-                        "payload": list(ev.payload),
-                    }
-                )
-            )
+            row = {
+                "machine": machine,
+                "seed": seed,
+                "step": ev.step,
+                "t_us": ev.time_us,
+                "kind": ev.kind,
+                "node": ev.node,
+                "src": ev.src,
+                "payload": list(ev.payload),
+            }
+            if ev.seq >= 0:
+                row["seq"] = ev.seq
+            if ev.prov:
+                row["prov"] = ev.prov & 0xFFFFFFFF
+            f.write(json.dumps(row))
             f.write("\n")
     return len(events)

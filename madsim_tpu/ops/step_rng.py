@@ -46,7 +46,11 @@ message slot) is appended at the END of both layouts — existing section
 offsets never move, so every recorded stream stays byte-stable with the
 flag off. The torn-write salt section (`FaultPlan.allow_torn`, PR-6: one
 word per step, folded into the torn-restart damage draw) appends after
-it under the same contract. The engine
+it under the same contract. The causal-provenance gate (PR-7,
+`EngineConfig.provenance`) deliberately consumes NO words in either
+version — lineage words are pure dataflow over values the step already
+has — so it needs no section here and provably cannot move a recorded
+stream. The engine
 additionally elides the *compute* that consumes a section when it is
 statically inert (e.g. loss_rate==0 and no storms ⇒ the drop compare
 always yields False) — that elision is result-preserving in both

@@ -24,6 +24,28 @@ FR_FAULT_KINDS = (
 )
 FR_EXTRAS = ("dup", "amnesia")
 
+# Causal-provenance word layout (mirrors engine/core.py PROV_*): bits
+# [0, 30) = scheduled fault slots, bit 30 = crash-with-amnesia wipe,
+# bit 31 = duplicate delivery. Kept as literals so host-side consumers
+# (the `/stats` service, dashboards) can decode words without jax.
+PROV_FAULT_BITS = 30
+PROV_BIT_AMNESIA = 30
+PROV_BIT_DUP = 31
+
+
+def prov_word_bits(word: int) -> Dict[str, object]:
+    """Split a violation provenance word into its raw channels:
+    implicated scheduled-fault slot indices plus the two non-scheduled
+    chaos flags. Kind names need the seed's fault schedule —
+    engine/provenance.py decodes those; this is the schedule-free
+    half."""
+    w = int(word) & 0xFFFFFFFF
+    return {
+        "fault_slots": [i for i in range(PROV_FAULT_BITS) if (w >> i) & 1],
+        "amnesia": bool((w >> PROV_BIT_AMNESIA) & 1),
+        "dup": bool((w >> PROV_BIT_DUP) & 1),
+    }
+
 
 def fr_metrics_dict(vec: Sequence[int]) -> Dict[str, object]:
     """Decode a flight-recorder metrics vector: per-kind fault injection

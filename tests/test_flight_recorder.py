@@ -254,8 +254,10 @@ def test_audit_cli_record_then_skew(tmp_path):
 
 def test_trace_export_perfetto_and_jsonl(tmp_path):
     """`trace` exports a well-formed Chrome trace_event JSON (metadata +
-    one instant per replayed event, virtual-us timestamps) and a JSONL
-    file that round-trips the trace exactly."""
+    one 1µs slice per replayed event at virtual-us timestamps — slices,
+    not instants, so the send->delivery flow arrows can bind; fault
+    events additionally carry a global instant marker) and a JSONL file
+    that round-trips the trace exactly."""
     from madsim_tpu.__main__ import main
 
     pf = str(tmp_path / "out.json")
@@ -266,15 +268,26 @@ def test_trace_export_perfetto_and_jsonl(tmp_path):
     ])
     assert rc in (0, 1)  # the seed may pass or fail; both export
     doc = json.load(open(pf))
-    evs = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
     meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
     assert evs and any(m["name"] == "thread_name" for m in meta)
     lines = [json.loads(l) for l in open(jl)]
     assert len(lines) == len(evs)
     # JSONL rows mirror the replay trace (step/time/node agree with the
-    # perfetto instants one-for-one, in order)
+    # perfetto slices one-for-one, in order)
     for row, ev in zip(lines, evs):
         assert row["t_us"] == ev["ts"] and row["node"] == ev["tid"]
         assert row["step"] == ev["args"]["step"]
     steps = [r["step"] for r in lines]
     assert steps == sorted(steps)
+    # message causality: every delivered message draws a flow arrow
+    # (ph s/f pairs) from its sender's slice, and fault injections get
+    # globally-scoped instant markers named by kind
+    n_msgs = sum(1 for r in lines if r["kind"] == "msg")
+    starts = [e for e in doc["traceEvents"] if e["ph"] == "s"]
+    ends = [e for e in doc["traceEvents"] if e["ph"] == "f"]
+    assert len(starts) == len(ends) == n_msgs
+    inj = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    n_faults = sum(1 for r in lines if r["kind"] == "fault")
+    assert len(inj) == n_faults
+    assert all(e["name"].startswith("inject ") for e in inj)

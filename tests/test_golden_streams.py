@@ -555,3 +555,80 @@ def test_engine_v2_block_matches_module():
         ),
     )
     assert eng3._rng_layout == _v3_layout()
+
+
+# -- causal provenance (PR-7) ------------------------------------------------
+
+# End-to-end golden violation provenance words: demo-volatilecommit-raft
+# under the default CLI-shaped chaos config, one pinned failing seed per
+# stream version. The word is a pure function of the seed and the
+# documented OR-along-delivery dataflow — any engine change that moves
+# it is a provenance-layout-breaking event (ship a new layout, don't
+# edit the constants). 0x40000002 = scheduled fault #1 (the kill) +
+# bit 30 (the crash-with-amnesia wipe); 0x40000001 = fault #0 + bit 30.
+PROV_PINNED = {
+    2: (5, 102, 0x40000002),
+    3: (8, 102, 0x40000001),
+}
+
+
+def _volatile_prov_engine(rng_stream):
+    from madsim_tpu.__main__ import build_machine
+
+    return Engine(
+        build_machine("demo-volatilecommit-raft", 0),
+        EngineConfig(
+            horizon_us=5_000_000,
+            queue_capacity=96,
+            rng_stream=rng_stream,
+            faults=FaultPlan(
+                n_faults=2, t_max_us=3_000_000, dur_min_us=100_000,
+                dur_max_us=800_000, strict_restart=True,
+            ),
+            provenance=True,
+        ),
+    )
+
+
+def test_provenance_word_layout_pinned():
+    """The provenance word layout contract: scheduled fault f owns bit
+    min(f, 29), bits 30/31 are the amnesia/dup channels, and init_lane's
+    eq_prov plane carries exactly the slot bits (boot timers are causal
+    roots) — under BOTH fault-schedule derivations, so the layout can
+    never drift with the vocabulary."""
+    from madsim_tpu.engine.core import (
+        PROV_BIT_AMNESIA,
+        PROV_BIT_DUP,
+        PROV_FAULT_BITS,
+        prov_fault_bit,
+    )
+
+    assert (PROV_FAULT_BITS, PROV_BIT_AMNESIA, PROV_BIT_DUP) == (30, 30, 31)
+    assert prov_fault_bit(0) == 1
+    assert prov_fault_bit(29) == prov_fault_bit(40) == 2 ** 29  # tail aliases
+    for faults in (V1_FAULTS, V2_FAULTS):
+        eng = Engine(
+            RaftMachine(num_nodes=5, log_capacity=8),
+            EngineConfig(
+                horizon_us=5_000_000, queue_capacity=32, faults=faults,
+                provenance=True,
+            ),
+        )
+        s = eng.init_lane(7)
+        prov = s.eq_prov.tolist()
+        assert prov[:5] == [0] * 5, faults  # boot timers: roots
+        assert prov[5:9] == [1, 1, 2, 2], faults  # fault slots own their bit
+        assert not any(prov[9:]), faults
+
+
+@pytest.mark.parametrize("rng_stream", [2, 3], ids=["rng-v2", "rng-v3"])
+def test_provenance_violation_word_pinned(rng_stream):
+    """Golden end-to-end words, one per stream version: the pinned seed
+    must fail with the pinned code AND the exact pinned provenance word
+    on the host replay path (the same lane_step ops the device runs)."""
+    from madsim_tpu.engine.replay import replay
+
+    seed, code, word = PROV_PINNED[rng_stream]
+    rp = replay(_volatile_prov_engine(rng_stream), seed, max_steps=3000, trace=False)
+    assert rp.failed and rp.fail_code == code
+    assert int(rp.state.fail_prov) == word, hex(int(rp.state.fail_prov))
