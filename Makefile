@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test stest rtest check bench rpc-bench explore examples audit
+.PHONY: test stest rtest check lint bench rpc-bench explore examples audit
 
 # full suite (host engine + TPU engine on a hermetic 8-dev CPU mesh)
 test:
@@ -31,6 +31,10 @@ check:
 	MADSIM_TEST_NUM=8 MADSIM_TEST_CHECK_DETERMINISM=1 \
 		$(PY) -m pytest tests/test_rand.py -x -q
 	$(PY) -m madsim_tpu check --machine raft --seeds 32
+
+# determinism & contract static analysis (pre-commit friendly exits)
+lint:
+	$(PY) -m madsim_tpu lint madsim_tpu/
 
 # flagship benchmark (one JSON line; real chip when available)
 bench:

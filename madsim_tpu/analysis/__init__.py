@@ -1,0 +1,35 @@
+"""Static analysis for determinism and engine contracts.
+
+The Rust reference enforces determinism *at runtime*: under
+``cfg(madsim)`` every nondeterminism source (libc entropy, clocks,
+thread scheduling) is intercepted and replaced with the seeded
+simulator. Python/JAX offers no such interception point — a stray
+`time.time()` or an unordered `jax.debug.callback` compiles fine and
+only surfaces months later as corpus rot. This package is the
+static-analysis analogue of madsim's interception layer: it refuses the
+hazard at review time instead of replaying it at debug time.
+
+Three rule families (stable IDs, `# madsim: allow(...)` suppressions,
+checked-in baseline — see findings.py):
+
+* **D-rules** (`drules.py`) — determinism hazards, pure stdlib-`ast`
+  over any python source: wall clocks, entropy, unordered set
+  iteration, `id()`/`hash()`, unordered host callbacks, python
+  truthiness on traced values inside Machine handlers.
+* **C-rules** (`crules.py`) — `Machine` authoring-contract checks: an
+  AST half (handler purity, the voter-bitmask cap) plus an import half
+  that instantiates each model and verifies `durable_spec()` /
+  `torn_spec()` congruence and the `coverage_projection` scalar
+  contract without running a simulation.
+* **G-rules** (`grules.py`) — whole-repo gate-discipline cross-checks:
+  every fault kind/flag present in every host mirror, the shrink
+  ablation table, the CLI vocabulary, the gate-off bit-identity matrix
+  and the golden-stream pins; plus the RNG-layout manifest audit
+  (tail-only growth, `ops/rng_layout.manifest`).
+
+Entry point: ``python -m madsim_tpu lint [paths]`` (cli.py). The D/C-AST
+and G passes never import jax; the C import half does (models are jax
+programs) and can be disabled with ``--no-import-check``.
+"""
+
+from .findings import Finding, Severity  # noqa: F401

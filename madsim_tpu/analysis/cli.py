@@ -1,0 +1,313 @@
+"""`python -m madsim_tpu lint` — driver, output formats, exit codes.
+
+Exit codes (pre-commit friendly):
+  0  clean (or everything suppressed/baselined)
+  1  findings
+  2  usage / internal error (bad paths, unparseable baseline)
+
+The D/C-AST/G passes are stdlib-only; the C import half (model
+contracts) imports jax and runs by default when any linted file defines
+a Machine subclass — `--no-import-check` keeps a pre-commit hook
+jax-free and fast.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from . import crules, drules, grules
+from .findings import (
+    DEFAULT_BASELINE_NAME,
+    Finding,
+    apply_baseline,
+    filter_suppressed,
+    load_baseline,
+    save_baseline,
+)
+
+JSON_SCHEMA_VERSION = 1
+
+# directories never worth descending into
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", ".claude"}
+
+
+def add_lint_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "paths", nargs="*", default=None,
+        help="files/directories to lint (default: the madsim_tpu package "
+             "of the enclosing repo)",
+    )
+    p.add_argument(
+        "--rules", default=None,
+        help="comma list of rule families or IDs to run (e.g. D,G or "
+             "D003,C001); default all",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--github", action="store_true",
+        help="GitHub workflow-command annotations (::error file=...)",
+    )
+    p.add_argument(
+        "--fix", action="store_true",
+        help="apply the mechanical fixes (sorted() set iteration, "
+             "ordered=True callbacks) in place, then re-lint",
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: {DEFAULT_BASELINE_NAME} at the "
+             f"repo root when present)",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="write all current findings to the baseline file and exit 0",
+    )
+    p.add_argument(
+        "--no-import-check", action="store_true",
+        help="skip the C-rule import half (no jax import; AST-only run)",
+    )
+    p.add_argument(
+        "--repo-root", default=None,
+        help="repo root for the G-pass cross-checks (default: walk up "
+             "from the first path)",
+    )
+    p.add_argument("-v", "--verbose", action="store_true")
+
+
+def _collect_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in _SKIP_DIRS
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def _rule_selected(rule: str, selector: Optional[Sequence[str]]) -> bool:
+    if not selector:
+        return True
+    return any(rule == s or rule.startswith(s) for s in selector)
+
+
+def run_lint(
+    paths: Sequence[str],
+    *,
+    rules: Optional[Sequence[str]] = None,
+    import_check: bool = True,
+    repo_root: Optional[str] = None,
+    verbose: bool = False,
+    notes: Optional[List[str]] = None,
+) -> tuple:
+    """Run the passes. Returns (findings, source_by_path) BEFORE
+    suppression/baseline filtering — the caller owns policy."""
+    import ast as _ast
+
+    files = _collect_files(paths)
+    findings: List[Finding] = []
+    source_by_path: Dict[str, str] = {}
+    notes = notes if notes is not None else []
+
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as exc:
+            notes.append(f"{path}: unreadable ({exc!r})")
+            continue
+        source_by_path[path] = source
+        try:
+            tree = _ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            findings.append(Finding(
+                rule="D000", severity="error", path=path,
+                line=exc.lineno or 0, col=(exc.offset or 1) - 1,
+                message=f"syntax error: {exc.msg}",
+            ))
+            continue
+        findings.extend(drules.check_module(tree, source, path))
+        findings.extend(crules.check_module(tree, source, path))
+        if import_check:
+            from .astutils import machine_classes
+
+            if machine_classes(tree):
+                c_findings, skipped = crules.check_module_contracts(
+                    tree, source, path
+                )
+                findings.extend(c_findings)
+                notes.extend(skipped)
+
+    root = repo_root or (grules.find_repo_root(files[0]) if files else None)
+    if root is None and files:
+        notes.append(
+            "no madsim_tpu repo root found above the linted paths; "
+            "G-pass (mirror cross-checks) skipped"
+        )
+    elif root is not None:
+        g = grules.check_repo(root)
+        # G findings report repo-relative paths; qualify with the root
+        # when linting from elsewhere so editors can open them
+        if os.path.abspath(root) != os.path.abspath(os.getcwd()):
+            g = [
+                Finding(
+                    rule=f.rule, severity=f.severity,
+                    path=os.path.join(root, f.path), line=f.line,
+                    col=f.col, message=f.message, fixable=f.fixable,
+                )
+                for f in g
+            ]
+        findings.extend(g)
+
+    selector = [s.strip() for s in rules] if rules else None
+    findings = [f for f in findings if _rule_selected(f.rule, selector)]
+
+    # dedup (the taint pass can flag one expression through two node
+    # shapes) and order stably for output + baseline
+    seen = set()
+    unique: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        # positional dedup for source findings (the taint pass can flag
+        # one expression through two node shapes); repo-level findings
+        # all sit at line 0, so their identity is the message
+        key = (
+            (f.rule, f.path, f.line, f.col) if f.line
+            else (f.rule, f.path, f.message)
+        )
+        if key in seen:
+            continue
+        seen.add(key)
+        unique.append(f)
+    return unique, source_by_path
+
+
+def main(args: argparse.Namespace) -> int:
+    paths = list(args.paths or [])
+    repo_root = args.repo_root
+    if not paths:
+        root = grules.find_repo_root(os.getcwd())
+        if root is None:
+            print(
+                "lint: no paths given and no madsim_tpu repo above cwd",
+                file=sys.stderr,
+            )
+            return 2
+        paths = [os.path.join(root, "madsim_tpu")]
+        repo_root = repo_root or root
+
+    rules = args.rules.split(",") if args.rules else None
+    notes: List[str] = []
+
+    try:
+        files_exist = _collect_files(paths)
+    except FileNotFoundError as exc:
+        print(f"lint: no such path: {exc}", file=sys.stderr)
+        return 2
+    del files_exist
+
+    if args.fix:
+        from .fixes import fix_source
+
+        fixed_total = 0
+        for path in _collect_files(paths):
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            try:
+                new_src, n = fix_source(src, path)
+            except SyntaxError:
+                continue
+            if n:
+                with open(path, "w", encoding="utf-8") as fh:
+                    fh.write(new_src)
+                fixed_total += n
+                if not args.json:
+                    print(f"fixed {n} finding(s) in {path}")
+        if fixed_total and not args.json:
+            print(f"--fix applied {fixed_total} edit(s); re-linting")
+
+    try:
+        findings, sources = run_lint(
+            paths,
+            rules=rules,
+            import_check=not args.no_import_check,
+            repo_root=repo_root,
+            verbose=args.verbose,
+            notes=notes,
+        )
+    except FileNotFoundError as exc:
+        print(f"lint: no such path: {exc}", file=sys.stderr)
+        return 2
+
+    findings = filter_suppressed(findings, sources)
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        root = repo_root or grules.find_repo_root(
+            paths[0] if paths else os.getcwd()
+        )
+        if root is not None:
+            candidate = os.path.join(root, DEFAULT_BASELINE_NAME)
+            if os.path.exists(candidate):
+                baseline_path = candidate
+
+    if args.update_baseline:
+        target = baseline_path or os.path.join(
+            repo_root or os.getcwd(), DEFAULT_BASELINE_NAME
+        )
+        save_baseline(target, findings)
+        print(f"baseline: wrote {len(findings)} finding(s) to {target}")
+        return 0
+
+    baselined = 0
+    if baseline_path:
+        try:
+            entries = load_baseline(baseline_path)
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"lint: bad baseline {baseline_path}: {exc}", file=sys.stderr)
+            return 2
+        findings, consumed = apply_baseline(findings, entries)
+        baselined = len(consumed)
+
+    if args.verbose:
+        for note in notes:
+            print(f"note: {note}", file=sys.stderr)
+
+    if args.json:
+        doc = {
+            "version": JSON_SCHEMA_VERSION,
+            "findings": [f.json_dict() for f in findings],
+            "counts": {
+                "error": sum(1 for f in findings if f.severity == "error"),
+                "warning": sum(1 for f in findings if f.severity == "warning"),
+                "baselined": baselined,
+            },
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    elif args.github:
+        for f in findings:
+            print(f.github())
+    else:
+        for f in findings:
+            print(f.text())
+
+    if not args.json and not args.github:
+        if findings:
+            n_err = sum(1 for f in findings if f.severity == "error")
+            tail = f", {baselined} baselined" if baselined else ""
+            print(f"lint: {n_err} error(s), {len(findings) - n_err} "
+                  f"warning(s){tail}")
+        else:
+            tail = f" ({baselined} baselined)" if baselined else ""
+            print(f"lint: clean{tail}")
+
+    return 1 if findings else 0

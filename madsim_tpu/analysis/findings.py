@@ -1,0 +1,192 @@
+"""Finding model, inline suppressions, and the checked-in baseline.
+
+A `Finding` is one rule violation at one source location. Three ways to
+silence one, in decreasing order of preference:
+
+* fix it;
+* an inline ``# madsim: allow(D003)`` on the flagged line (or a
+  comment-only line directly above it) — for deliberate, justified
+  exceptions; always pair it with a human reason in the comment;
+* a file-level ``# madsim: allow-file(D001,D002)`` comment line — for
+  modules whose whole *contract* is the exception (the real-mode
+  shims: wall clocks and OS entropy are their job);
+* the baseline file — for grandfathered findings when the linter is
+  introduced to an existing codebase. This repo ships an EMPTY baseline
+  (.madsim-lint-baseline.json) on purpose: CI starts strict.
+
+Baseline entries match on (rule, path, message) rather than line
+numbers, so unrelated edits above a grandfathered finding don't
+resurrect it; duplicate findings consume duplicate entries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # stable ID: D001..., C001..., G001...
+    severity: str  # Severity.*
+    path: str  # as given to the linter (repo-relative in CI)
+    line: int  # 1-based; 0 = whole-file/repo finding
+    col: int  # 0-based
+    message: str
+    fixable: bool = False  # `lint --fix` knows a mechanical rewrite
+
+    def text(self) -> str:
+        tag = " [fixable]" if self.fixable else ""
+        return (
+            f"{self.path}:{self.line}:{self.col + 1}: "
+            f"{self.rule} [{self.severity}]{tag} {self.message}"
+        )
+
+    def github(self) -> str:
+        # GitHub workflow-command annotation; error/warning map directly
+        kind = "error" if self.severity == Severity.ERROR else "warning"
+        return (
+            f"::{kind} file={self.path},line={max(self.line, 1)},"
+            f"col={self.col + 1},title={self.rule}::{self.message}"
+        )
+
+    def json_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "fixable": self.fixable,
+        }
+
+
+# -- inline suppressions -----------------------------------------------------
+
+_ALLOW_RE = re.compile(r"#\s*madsim:\s*allow\(([A-Z]\d{3}(?:\s*,\s*[A-Z]\d{3})*)\)")
+_ALLOW_FILE_RE = re.compile(
+    r"#\s*madsim:\s*allow-file\(([A-Z]\d{3}(?:\s*,\s*[A-Z]\d{3})*)\)"
+)
+
+
+def _ids(match: re.Match) -> set:
+    return {part.strip() for part in match.group(1).split(",")}
+
+
+class Suppressions:
+    """Per-file suppression map parsed from comments.
+
+    `line_allows[n]` holds rule IDs allowed on line n (1-based). A
+    comment-only line's allowance also covers the next line, so long
+    flagged expressions can carry the justification above them.
+    """
+
+    def __init__(self, source: str):
+        self.file_allows: set = set()
+        self.line_allows: Dict[int, set] = {}
+        lines = source.splitlines()
+        for lineno, text in enumerate(lines, start=1):
+            m = _ALLOW_FILE_RE.search(text)
+            if m:
+                self.file_allows |= _ids(m)
+                continue
+            m = _ALLOW_RE.search(text)
+            if not m:
+                continue
+            ids = _ids(m)
+            self.line_allows.setdefault(lineno, set()).update(ids)
+            if text.lstrip().startswith("#"):
+                # comment-only: the allowance extends through the rest
+                # of the comment block to the first code line below it
+                target = lineno + 1
+                while target <= len(lines) and lines[target - 1].lstrip().startswith("#"):
+                    target += 1
+                self.line_allows.setdefault(target, set()).update(ids)
+
+    def allows(self, finding: Finding) -> bool:
+        if finding.rule in self.file_allows:
+            return True
+        return finding.rule in self.line_allows.get(finding.line, set())
+
+
+def filter_suppressed(
+    findings: Sequence[Finding], source_by_path: Dict[str, str]
+) -> List[Finding]:
+    """Drop findings an inline/file suppression in their source allows.
+    Repo-level findings (G-rules, line 0) have no inline channel — the
+    mirrors they guard span files, so only the baseline can grandfather
+    them."""
+    out: List[Finding] = []
+    cache: Dict[str, Suppressions] = {}
+    for f in findings:
+        src = source_by_path.get(f.path)
+        if src is not None and f.line > 0:
+            sup = cache.get(f.path)
+            if sup is None:
+                sup = cache[f.path] = Suppressions(src)
+            if sup.allows(f):
+                continue
+        out.append(f)
+    return out
+
+
+# -- baseline ----------------------------------------------------------------
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_NAME = ".madsim-lint-baseline.json"
+
+
+def _key(entry: dict) -> Tuple[str, str, str]:
+    return (entry["rule"], entry["path"], entry["message"])
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: unsupported baseline version {doc.get('version')!r}"
+        )
+    return list(doc.get("findings", []))
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    doc = {
+        "version": BASELINE_VERSION,
+        "findings": [
+            {"rule": f.rule, "path": f.path, "message": f.message}
+            for f in sorted(findings, key=lambda f: (f.path, f.rule, f.line))
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Sequence[dict]
+) -> Tuple[List[Finding], List[dict]]:
+    """Split findings into (fresh, grandfathered-entries-consumed).
+    Matching is by (rule, path, message), count-aware: two identical
+    findings need two baseline entries."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for entry in baseline:
+        budget[_key(entry)] = budget.get(_key(entry), 0) + 1
+    fresh: List[Finding] = []
+    consumed: List[dict] = []
+    for f in findings:
+        k = (f.rule, f.path, f.message)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            consumed.append({"rule": f.rule, "path": f.path, "message": f.message})
+        else:
+            fresh.append(f)
+    return fresh, consumed

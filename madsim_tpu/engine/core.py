@@ -36,6 +36,7 @@ from jax import lax
 
 import os
 
+from .. import kinds as _kinds
 from ..compile_cache import enable_compile_cache
 from ..ops import find_free_slot, pop_earliest
 from ..ops.coverage import (
@@ -154,11 +155,10 @@ _DIGEST_M0 = 0x9E3779B1
 _DIGEST_M1 = 0x85EBCA6B
 
 # FaultPlan kind names, indexed by K_* — the fault-injection counter
-# labels used by run_stream stats / bench / audit output.
-FAULT_KIND_NAMES = (
-    "pair", "kill", "dir", "group", "storm", "delay", "pause", "skew",
-    "torn", "heal-asym",
-)
+# labels used by run_stream stats / bench / audit output. The table
+# lives in madsim_tpu/kinds.py (single source of truth for every host
+# mirror; `python -m madsim_tpu lint` cross-checks the consumers).
+FAULT_KIND_NAMES = _kinds.FAULT_KIND_NAMES
 
 # -- causal provenance (observability) ---------------------------------------
 # One uint32 word per queued event and per node (`EngineConfig.
@@ -189,7 +189,7 @@ def prov_fault_bit(fault_index: int) -> int:
 # Non-scheduled chaos injection counters (flight recorder): Bernoulli
 # message duplicates pushed, and strict (crash-with-amnesia) restarts
 # applied. They ride fr_metrics after the per-kind totals.
-FR_EXTRA_NAMES = ("dup", "amnesia")
+FR_EXTRA_NAMES = _kinds.FR_EXTRA_NAMES
 
 # StreamCarry.fr_metrics layout: per-kind injection totals, the extra
 # chaos counters (all summed at harvest), then queue / clogged-link /

@@ -35,27 +35,21 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+from ..kinds import FLAG_BY_KIND
 from .core import Engine, EngineConfig
 from .replay import ReplayResult, replay
 
 
 # Ablation order: newest/most-exotic kinds first so the reported
-# minimal set leans on the legacy vocabulary when possible. Each entry
-# is (report name, FaultPlan field).
-ABLATABLE_KINDS = (
-    ("torn", "allow_torn"),
-    ("heal-asym", "allow_heal_asym"),
-    ("delay", "allow_delay"),
-    ("storm", "allow_storm"),
-    ("group", "allow_group"),
-    ("dir", "allow_dir_clog"),
-    ("pause", "allow_pause"),
-    ("skew", "allow_skew"),
-    ("dup", "allow_dup"),
-    ("strict-restart", "strict_restart"),
-    ("kill", "allow_kill"),
-    ("pair", "allow_partition"),
+# minimal set leans on the legacy vocabulary when possible. The order
+# is shrink policy; the name -> FaultPlan-field pairing comes from the
+# shared madsim_tpu/kinds.py table (lint rule G003 asserts this list
+# covers the whole vocabulary). Each entry is (report name, field).
+ABLATION_ORDER = (
+    "torn", "heal-asym", "delay", "storm", "group", "dir",
+    "pause", "skew", "dup", "strict-restart", "kill", "pair",
 )
+ABLATABLE_KINDS = tuple((name, FLAG_BY_KIND[name]) for name in ABLATION_ORDER)
 
 
 @dataclasses.dataclass

@@ -58,6 +58,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .. import kinds as _kinds
+
 # Default map size: 2^14 slots = 512 packed int32 words = 2 KiB per
 # lane. AFL's classic 64 KiB map tracks edge pairs of real binaries;
 # the engine's abstract scenario space is far smaller, and 2 KiB keeps
@@ -66,17 +68,14 @@ COV_SLOTS_LOG2_DEFAULT = 14
 COV_WORD_BITS = 32  # slots per packed map word
 
 # Band index space (top bits of the slot): event class, with fault
-# events split per FaultPlan kind. Mirrored as literals in
-# runtime/coverage.py (the host decoder never imports jax).
+# events split per FaultPlan kind. Names come from madsim_tpu/kinds.py
+# (runtime/coverage.py binds the same table; no jax there).
 COV_BAND_BITS = 3       # layout v1 (PR-4): 8 bands
 COV_BAND_BITS_V2 = 4    # layout v2 (PR-5 chaos kinds): 16 bands
 COV_PHASE_BITS = 3
 COV_BANDS = 1 << COV_BAND_BITS
-COV_BAND_NAMES = ("timer", "msg", "pair", "kill", "dir", "group", "storm", "delay")
-COV_BAND_NAMES_V2 = COV_BAND_NAMES + (
-    "pause", "skew", "dup", "amnesia",
-    "torn", "heal_asym", "reserved14", "reserved15",
-)
+COV_BAND_NAMES = _kinds.COV_BAND_NAMES
+COV_BAND_NAMES_V2 = _kinds.COV_BAND_NAMES_V2
 # v2 synthetic bands (no popped-event class of their own; the engine
 # passes them via cov_slot's `band` override)
 COV_BAND_DUP = 10

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import asyncio
 import random as _pyrandom
+# madsim: allow-file(D001) — this module IS the real-mode shim: in
+# MADSIM_TPU_MODE=real the OS clock is the contract, not a hazard.
 import time as _pytime
 from typing import Any, Awaitable, Optional, Union
 

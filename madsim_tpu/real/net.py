@@ -249,6 +249,8 @@ class Endpoint:
     async def call_with_data(
         self, dst: Any, req: Request, data: bytes, timeout: Optional[float] = None
     ) -> Tuple[Any, bytes]:
+        # madsim: allow(D002) — real-socket mode: tag collisions are
+        # the only stake, OS entropy is fine (and sim mode never runs this)
         rsp_tag = int.from_bytes(os.urandom(8), "little")
 
         async def round_trip() -> Tuple[Any, bytes]:

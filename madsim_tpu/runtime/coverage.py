@@ -43,12 +43,9 @@ COV_BAND_BITS = 3
 COV_PHASE_BITS = 3
 COV_BANDS = 1 << COV_BAND_BITS
 COV_PHASES = 1 << COV_PHASE_BITS
-# band 0/1: event class; 2..: fault kind (mirrors core.FAULT_KIND_NAMES)
-COV_BAND_NAMES = ("timer", "msg", "pair", "kill", "dir", "group", "storm", "delay")
-COV_BAND_NAMES_V2 = COV_BAND_NAMES + (
-    "pause", "skew", "dup", "amnesia",
-    "torn", "heal_asym", "reserved14", "reserved15",
-)
+# band 0/1: event class; 2..: fault kind — the shared table in
+# madsim_tpu/kinds.py (pure literals, no jax import for this decoder)
+from ..kinds import COV_BAND_NAMES, COV_BAND_NAMES_V2
 
 # doc v1: band_bits implicitly 3; v2 carries an explicit band_bits field
 COV_DOC_VERSION = 2

@@ -15,14 +15,11 @@ from typing import TYPE_CHECKING, Dict, Sequence
 if TYPE_CHECKING:
     from ..task.executor import Executor
 
-# Mirrors engine/core.py's FAULT_KIND_NAMES / FR_EXTRA_NAMES /
-# FR_METRICS_LEN (kept as literals here so this host-side module never
-# imports jax).
-FR_FAULT_KINDS = (
-    "pair", "kill", "dir", "group", "storm", "delay", "pause", "skew",
-    "torn", "heal-asym",
-)
-FR_EXTRAS = ("dup", "amnesia")
+# The same table engine/core.py's FAULT_KIND_NAMES / FR_EXTRA_NAMES
+# bind — via madsim_tpu/kinds.py (pure literals, no jax import), so
+# this host-side decoder can never drift from the device counters.
+from ..kinds import FAULT_KIND_NAMES as FR_FAULT_KINDS
+from ..kinds import FR_EXTRA_NAMES as FR_EXTRAS
 
 # Causal-provenance word layout (mirrors engine/core.py PROV_*): bits
 # [0, 30) = scheduled fault slots, bit 30 = crash-with-amnesia wipe,

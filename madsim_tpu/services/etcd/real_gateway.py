@@ -33,6 +33,8 @@ _CMP_TARGET = {0: "version", 1: "create_revision", 2: "mod_revision", 3: "value"
 
 class _Rng:
     def gen_range(self, lo: int, hi: int) -> int:
+        # madsim: allow(D002) — real-gateway lease ids face real
+        # clients; sim mode injects the seeded Rng instead
         return random.randrange(lo, hi)
 
 

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import asyncio
 import struct
+# madsim: allow-file(D001) — genuine-wire Kafka gateway: log append
+# timestamps are protocol fields real clients read; real mode only.
 import time
 from typing import Dict, List, Optional, Tuple
 

@@ -15,6 +15,8 @@ from __future__ import annotations
 import asyncio
 import datetime
 import random
+# madsim: allow-file(D001,D002) — genuine-wire S3 gateway: runs only
+# against real clients on real sockets (request ids, lifecycle now).
 import time
 import urllib.parse
 from email.utils import formatdate

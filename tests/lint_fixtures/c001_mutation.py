@@ -1,0 +1,28 @@
+"""Lint fixture: C001 self.* mutation in pure handlers (AST-only)."""
+
+
+class Machine:  # stand-in base
+    pass
+
+
+class StatefulMachine(Machine):
+    def __init__(self):
+        self.count = 0  # ok: constructor
+
+    def on_message(self, nodes, node, src, payload, now_us, rand_u32):
+        self.count += 1  # LINT: C001 line 13
+        return nodes, None
+
+    def on_timer(self, nodes, node, timer_id, now_us, rand_u32):
+        self.cache = {}  # LINT: C001 line 17
+        self.seen.append(node)  # LINT: C001 line 18
+        return nodes, None
+
+    def invariant(self, nodes, now_us):
+        self.checked = True  # LINT: C001 line 22
+        return True, 0
+
+    def restart_if(self, nodes, i, cond, rng_key):
+        self.restarts = 0  # ok: not in the pure-handler set (still
+        # wrong, but restart hooks may legally memoize fresh trees)
+        return nodes

@@ -1,0 +1,24 @@
+"""Lint fixture: C005 voter bitmask without the 31-node cap."""
+
+import jax.numpy as jnp
+
+
+class Machine:  # stand-in base
+    pass
+
+
+class UncappedVoteMachine(Machine):
+    def on_message(self, nodes, node, src, payload, now_us, rand_u32):
+        votes_mask = nodes.votes_mask[node] | (jnp.int32(1) << src)  # LINT: C005 line 12
+        return nodes, votes_mask
+
+
+class CappedVoteMachine(Machine):
+    def __init__(self, num_nodes=5):
+        if num_nodes > 31:  # the cap C005 wants
+            raise ValueError("int32 voter bitmask caps num_nodes at 31")
+        self.num_nodes = num_nodes
+
+    def on_message(self, nodes, node, src, payload, now_us, rand_u32):
+        votes_mask = nodes.votes_mask[node] | (jnp.int32(1) << src)  # ok: capped
+        return nodes, votes_mask

@@ -1,0 +1,105 @@
+"""Lint fixture: C002/C003/C004 model-contract violations.
+
+Unlike the other fixtures this one IS imported (the C import half
+instantiates each class) — it must construct, and its bugs live in the
+contracts, not the syntax.
+"""
+
+import jax.numpy as jnp
+
+from madsim_tpu.engine.machine import Machine, TORN_LOSE
+from flax import struct
+
+
+@struct.dataclass
+class _State:
+    log: jnp.ndarray
+    commit: jnp.ndarray
+
+
+class BadDurableSpecMachine(Machine):
+    NUM_NODES = 3
+
+    def init(self, rng_key):
+        return _State(
+            log=jnp.zeros((self.NUM_NODES, 4), jnp.int32),
+            commit=jnp.zeros((self.NUM_NODES,), jnp.int32),
+        )
+
+    def durable_spec(self):
+        # LINT C002: not congruent — missing the `commit` leaf
+        return {"log": True}
+
+    def on_timer(self, nodes, node, timer_id, now_us, rand_u32):
+        return nodes, self.empty_outbox()
+
+    def on_message(self, nodes, node, src, payload, now_us, rand_u32):
+        return nodes, self.empty_outbox()
+
+
+class BadTornSpecMachine(Machine):
+    NUM_NODES = 3
+
+    def init(self, rng_key):
+        return _State(
+            log=jnp.zeros((self.NUM_NODES, 4), jnp.int32),
+            commit=jnp.zeros((self.NUM_NODES,), jnp.int32),
+        )
+
+    def durable_spec(self):
+        return _State(log=True, commit=True)
+
+    def torn_spec(self):
+        # LINT C003: 99 is not a legal atomicity class
+        return _State(log=TORN_LOSE, commit=99)
+
+    def on_timer(self, nodes, node, timer_id, now_us, rand_u32):
+        return nodes, self.empty_outbox()
+
+    def on_message(self, nodes, node, src, payload, now_us, rand_u32):
+        return nodes, self.empty_outbox()
+
+
+class VectorProjectionMachine(Machine):
+    NUM_NODES = 3
+
+    def init(self, rng_key):
+        return _State(
+            log=jnp.zeros((self.NUM_NODES, 4), jnp.int32),
+            commit=jnp.zeros((self.NUM_NODES,), jnp.int32),
+        )
+
+    def coverage_projection(self, nodes, now_us):
+        # LINT C004: a vector, not the scalar word the map folds
+        return nodes.commit.astype(jnp.uint32)
+
+    def on_timer(self, nodes, node, timer_id, now_us, rand_u32):
+        return nodes, self.empty_outbox()
+
+    def on_message(self, nodes, node, src, payload, now_us, rand_u32):
+        return nodes, self.empty_outbox()
+
+
+class HonestContractMachine(Machine):
+    NUM_NODES = 3
+
+    def init(self, rng_key):
+        return _State(
+            log=jnp.zeros((self.NUM_NODES, 4), jnp.int32),
+            commit=jnp.zeros((self.NUM_NODES,), jnp.int32),
+        )
+
+    def durable_spec(self):
+        return _State(log=True, commit=False)
+
+    def torn_spec(self):
+        return _State(log=TORN_LOSE, commit=TORN_LOSE)
+
+    def coverage_projection(self, nodes, now_us):
+        return jnp.max(nodes.commit).astype(jnp.uint32)
+
+    def on_timer(self, nodes, node, timer_id, now_us, rand_u32):
+        return nodes, self.empty_outbox()
+
+    def on_message(self, nodes, node, src, payload, now_us, rand_u32):
+        return nodes, self.empty_outbox()

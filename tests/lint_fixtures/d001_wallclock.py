@@ -1,0 +1,17 @@
+"""Lint fixture: D001 wall-clock reads (never imported; AST-only)."""
+
+import time
+import datetime
+import time as wall
+
+
+def stamp():
+    return time.time()  # LINT: D001 line 9
+
+
+def tick():
+    return wall.perf_counter()  # LINT: D001 line 13
+
+
+def today():
+    return datetime.datetime.now()  # LINT: D001 line 17

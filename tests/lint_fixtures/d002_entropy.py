@@ -1,0 +1,28 @@
+"""Lint fixture: D002 OS/global entropy (never imported; AST-only)."""
+
+import os
+import random
+import uuid
+import numpy as np
+
+
+def roll():
+    return random.randint(0, 6)  # LINT: D002 line 10
+
+
+def token():
+    return os.urandom(16)  # LINT: D002 line 14
+
+
+def ident():
+    return uuid.uuid4()  # LINT: D002 line 18
+
+
+def noise():
+    rng = np.random.default_rng()  # LINT: D002 line 22 (unseeded)
+    return rng.random()
+
+
+def seeded_ok(seed):
+    rng = np.random.default_rng(seed)  # ok: explicit seed
+    return rng.random()

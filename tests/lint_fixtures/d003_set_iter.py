@@ -1,0 +1,16 @@
+"""Lint fixture: D003 set-order iteration (never imported; AST-only)."""
+
+
+def leak(names):
+    out = []
+    for n in set(names):  # LINT: D003 line 6
+        out.append(n)
+    return out
+
+
+def comp(names):
+    return [n for n in {"a", "b", "c"}]  # LINT: D003 line 12
+
+
+def fine(names):
+    return [n for n in sorted(set(names))]  # ok: sorted

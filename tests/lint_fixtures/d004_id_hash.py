@@ -1,0 +1,14 @@
+"""Lint fixture: D004 id()/hash() (never imported; AST-only)."""
+
+
+def key_by_identity(obj):
+    return id(obj)  # LINT: D004 line 5
+
+
+def bucket(name, n):
+    return hash(name) % n  # LINT: D004 line 9
+
+
+class Point:
+    def __hash__(self):
+        return hash((self.x, self.y))  # ok: __hash__ protocol itself

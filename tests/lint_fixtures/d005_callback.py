@@ -1,0 +1,18 @@
+"""Lint fixture: D005 unordered host callbacks (never imported)."""
+
+import jax
+from jax.experimental import io_callback
+
+
+def log_step(x):
+    jax.debug.callback(print, x)  # LINT: D005 line 8
+    return x
+
+
+def poke(f, s, x):
+    return io_callback(f, s, x, ordered=False)  # LINT: D005 line 13
+
+
+def ordered_ok(f, s, x):
+    jax.debug.callback(print, x, ordered=True)  # ok
+    return io_callback(f, s, x, ordered=True)  # ok
