@@ -118,11 +118,44 @@ import jax  # noqa: E402
 
 
 def main() -> None:
+    """Entry point: `MADSIM_TPU_PERF_TIMELINE=path` wraps the whole
+    bench in a PerfRecorder (madsim_tpu/perf) so the capture ships with
+    its host timeline — where the 8 minutes actually went (compile vs
+    blocked-on-device vs host Python). The JSON-line stdout contract is
+    untouched; the timeline summary prints to stderr. (Via `python -m
+    madsim_tpu bench --perf-timeline`, the CLI's recorder is already
+    active in-process and this env path is not needed.)"""
+    path = os.environ.get("MADSIM_TPU_PERF_TIMELINE")
+    if not path:
+        return _main_impl()
+    from madsim_tpu.perf.recorder import PerfRecorder
+
+    rec = PerfRecorder(meta={"source": "bench.py"})
+    try:
+        with rec:
+            return _main_impl()
+    finally:
+        n = rec.write(path)
+        s = rec.summary()
+        print(
+            f"bench: host timeline {n} spans, "
+            f"{100 * s['span_coverage']:.0f}% of {s['wall_s']:.1f}s wall "
+            f"attributed -> {path}",
+            file=sys.stderr, flush=True,
+        )
+
+
+def _main_impl() -> None:
     import dataclasses
 
-    from madsim_tpu.compile_cache import active_compile_cache, enable_compile_cache
-    from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
-    from madsim_tpu.models.raft import RaftMachine
+    # the engine/flax import chain is seconds of real wall time — put
+    # it on the host timeline rather than leaving it unattributed
+    from madsim_tpu.perf.recorder import maybe_span
+
+    with maybe_span("engine_build"):
+        from madsim_tpu.compile_cache import active_compile_cache, enable_compile_cache
+        from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
+        from madsim_tpu.models.raft import RaftMachine
 
     # Persistent compilation cache (opt-in MADSIM_TPU_COMPILE_CACHE=dir):
     # sweeps and repeated bench captures pay the multi-second streaming
@@ -177,7 +210,8 @@ def main() -> None:
         coverage=coverage,
         provenance=provenance,
     )
-    eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
+    with maybe_span("engine_build"):
+        eng = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
 
     # Pipelined executor (round-6): device-side supersegments + donated
     # StreamCarry + K-deep async dispatch. MADSIM_TPU_STREAM_PIPELINE=0
@@ -217,77 +251,143 @@ def main() -> None:
     except OSError:
         load1 = None
 
-    # Optional per-gate attribution (MADSIM_TPU_BENCH_STEP_COST=1): time
-    # one shorter rep with each step-path gate individually toggled OFF
-    # so the win decomposes instead of arriving as a blob. Costs one
-    # compile + one rep per gate — off by default.
+    # Optional per-gate attribution (MADSIM_TPU_BENCH_STEP_COST): the
+    # old protocol timed ONE rep per gate against the early-run median
+    # — on a host that drifts ±10% across the bench that misread the
+    # provenance gate by 13x (PR-7 receipt: 8% single-rep vs 0.61%
+    # hand-interleaved). Each gate now runs through the interleaved A/B
+    # harness (madsim_tpu/perf/ab.py): ABAB… alternating reps against
+    # the flagship runner over identical seed ranges, median of PAIRED
+    # deltas + bootstrap 95% CI + sign test. Still one compile + one
+    # warm rep per gate; MADSIM_TPU_BENCH_AB_PAIRS (default 2) sets the
+    # pair count. Old key names preserved (step_cost[<key>] is still
+    # "rate with the gate toggled", now a median of interleaved reps);
+    # the paired detail lands under step_cost["ab"][<key>].
+    # Values: 1/all = every applicable gate; obs = the observability
+    # gates only; or an explicit comma list of keys.
     step_cost = None
-    if os.environ.get("MADSIM_TPU_BENCH_STEP_COST", "") not in ("", "0"):
-        def one_rate(engine):
-            r = engine.make_stream_runner(
+    sc_env = os.environ.get("MADSIM_TPU_BENCH_STEP_COST", "")
+    if sc_env not in ("", "0"):
+        from madsim_tpu.perf.ab import interleaved_ab
+
+        ab_pairs = int(os.environ.get("MADSIM_TPU_BENCH_AB_PAIRS", "2"))
+        menu = []
+        if cfg.rng_stream != 2:
+            menu.append(("rng_stream_v2", dataclasses.replace(cfg, rng_stream=2), {}))
+        if cfg.clog_packed:
+            menu.append(("clog_unpacked", dataclasses.replace(cfg, clog_packed=False), {}))
+        if eng.use_pallas_pop:
+            menu.append(("pallas_pop_off", cfg, {"use_pallas_pop": False}))
+        if cfg.flight_recorder:
+            menu.append(("flight_recorder_off",
+                         dataclasses.replace(cfg, flight_recorder=False), {}))
+        if cfg.coverage:
+            menu.append(("coverage_off", dataclasses.replace(cfg, coverage=False), {}))
+        if cfg.provenance:
+            menu.append(("provenance_off",
+                         dataclasses.replace(cfg, provenance=False), {}))
+        else:
+            # flagship runs provenance OFF (r09 receipt convention);
+            # the A/B then answers "what would turning it ON cost" —
+            # a POSITIVE delta here means the gate costs throughput
+            menu.append(("provenance_on",
+                         dataclasses.replace(cfg, provenance=True), {}))
+        if sc_env not in ("1", "all"):
+            want = (
+                {"flight_recorder_off", "coverage_off",
+                 "provenance_off", "provenance_on"}
+                if sc_env == "obs"
+                else {k.strip() for k in sc_env.split(",") if k.strip()}
+            )
+            menu = [m for m in menu if m[0] in want]
+
+        step_cost = {"all_gates_on": round(seeds_per_sec, 1), "ab": {}}
+        for key, vcfg, ekw in menu:
+            vrun = Engine(eng.machine, vcfg, **ekw).make_stream_runner(
                 batch=lanes, segment_steps=segment_steps, pipelined=pipelined
             )
-            r(1)
-            t0 = time.perf_counter()
-            out2 = r(2 * lanes, seed_start=3_000_000)
-            return round(out2["completed"] / (time.perf_counter() - t0), 1)
+            vrun(1)  # one compile per gate, as before
+            vrun(2 * lanes, seed_start=600_000)  # steady-state warm
+            res = interleaved_ab(
+                lambda s: run(2 * lanes, seed_start=s)["completed"],
+                lambda s, _v=vrun: _v(2 * lanes, seed_start=s)["completed"],
+                pairs=ab_pairs,
+                seed_start=3_000_000,
+                seeds_per_rep=4 * lanes,
+                label_a="all_gates_on",
+                label_b=key,
+            )
+            # the variant's rate under the OLD key name (consumers keep
+            # working), now a median of interleaved reps
+            step_cost[key] = round(res.median_b, 1)
+            step_cost["ab"][key] = res.to_dict()
+            print(f"bench step_cost: {res.summary()}", file=sys.stderr, flush=True)
 
-        step_cost = {"all_gates_on": round(seeds_per_sec, 1)}
-        if cfg.rng_stream != 2:
-            step_cost["rng_stream_v2"] = one_rate(
-                Engine(eng.machine, dataclasses.replace(cfg, rng_stream=2))
-            )
-        if cfg.clog_packed:
-            step_cost["clog_unpacked"] = one_rate(
-                Engine(eng.machine, dataclasses.replace(cfg, clog_packed=False))
-            )
-        if eng.use_pallas_pop:
-            step_cost["pallas_pop_off"] = one_rate(
-                Engine(eng.machine, cfg, use_pallas_pop=False)
-            )
-        if cfg.flight_recorder:
-            step_cost["flight_recorder_off"] = one_rate(
-                Engine(eng.machine, dataclasses.replace(cfg, flight_recorder=False))
-            )
-        if cfg.coverage:
-            step_cost["coverage_off"] = one_rate(
-                Engine(eng.machine, dataclasses.replace(cfg, coverage=False))
-            )
-        if cfg.provenance:
-            step_cost["provenance_off"] = one_rate(
-                Engine(eng.machine, dataclasses.replace(cfg, provenance=False))
-            )
+    # Drift-aware budget receipt (madsim_tpu/perf/history.py): the old
+    # check compared every capture against ONE absolute file (vs_r08),
+    # which conflates code regressions with box drift across eras. The
+    # baseline is now the NEWEST comparable history row — same
+    # platform, lanes and gate tuple (and host, when both recorded):
+    # the closest same-box/same-config capture in time. First capture
+    # of a config has no honest baseline -> budget None (CI's tiny
+    # 512-lane run never false-alarms by construction).
+    # MADSIM_TPU_BENCH_ENFORCE_BUDGET=1 still turns a violation into a
+    # nonzero exit for gating jobs.
+    from madsim_tpu.perf import history as bench_history
 
-    # 5%-budget receipt vs the r08 flagship capture (recorder + coverage
-    # ON — the PR-4 observability-era baseline; the PR-5 chaos kinds are
-    # statically gated off in this config, so the compiled step is the
-    # same work). Only comparable when the run SHAPE matches the
-    # recorded one (same lanes, same platform) — CI's tiny 512-lane
-    # capture must not false-alarm. MADSIM_TPU_BENCH_ENFORCE_BUDGET=1
-    # turns a violation into a nonzero exit for gating jobs.
-    budget = None
-    try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_r08.json")) as f:
-            r08 = json.load(f)
-        if (
-            r08["diagnostics"]["lanes"] == lanes
-            and r08["platform"] == jax.devices()[0].platform
-        ):
-            ratio = seeds_per_sec / r08["value"]
-            budget = {
-                "vs_r08": round(ratio, 3),
-                "within_5pct": ratio >= 0.95,
-            }
-            if not budget["within_5pct"]:
-                print(
-                    f"bench: BUDGET VIOLATION — {seeds_per_sec:.1f} seeds/s "
-                    f"is {100 * (1 - ratio):.1f}% below the r08 capture "
-                    f"({r08['value']}) with the observability gates on",
-                    file=sys.stderr, flush=True,
-                )
-    except (OSError, KeyError, ValueError):
-        budget = None
+    gates = {
+        "rng_stream": cfg.rng_stream,
+        "clog_packed": cfg.clog_packed,
+        "pallas_pop": eng.use_pallas_pop,
+        "flight_recorder": cfg.flight_recorder,
+        "coverage": cfg.coverage,
+        "provenance": cfg.provenance,
+        "compile_cache": active_compile_cache(),
+    }
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    hist_path = os.environ.get("MADSIM_TPU_BENCH_HISTORY") or os.path.join(
+        repo_dir, bench_history.DEFAULT_BASENAME
+    )
+    # first use seeds the history from the legacy BENCH_r*.json series,
+    # so the neighbor search starts with the whole recorded trajectory
+    hist_rows = bench_history.load_or_seed(hist_path, repo_dir=repo_dir)
+    fingerprint = bench_history.env_fingerprint(
+        backend_platform=jax.devices()[0].platform,
+        lanes=lanes,
+        reps=reps,
+        segment_steps=segment_steps,
+        gates=gates,
+    )
+    budget = bench_history.neighbor_budget(hist_rows, seeds_per_sec, fingerprint)
+    if budget is not None and not budget["within_5pct"]:
+        print(
+            f"bench: BUDGET VIOLATION — {seeds_per_sec:.1f} seeds/s is "
+            f"{100 * (1 - budget['vs_neighbor']):.1f}% below the "
+            f"{budget['neighbor']} capture ({budget['neighbor_value']}), "
+            f"the newest same-box/same-config neighbor",
+            file=sys.stderr, flush=True,
+        )
+
+    # every capture appends to the history (the bench trajectory is an
+    # artifact, not archaeology); MADSIM_TPU_BENCH_TAG overrides the
+    # auto-continued rNN tag
+    bench_tag = (
+        os.environ.get("MADSIM_TPU_BENCH_TAG") or bench_history.next_tag(hist_rows)
+    )
+    bench_history.append(
+        hist_path,
+        bench_history.make_record(
+            bench_tag,
+            round(seeds_per_sec, 1),
+            fingerprint,
+            reps=[round(x, 1) for x in rates],
+            compile_s=round(compile_s, 2),
+            spread_pct=round(100 * (max(rates) - min(rates)) / max(rates), 1),
+            host_load1=load1,
+            step_cost=step_cost,
+            source="bench.py",
+        ),
+    )
 
     print(
         json.dumps(
@@ -297,6 +397,12 @@ def main() -> None:
                 "unit": "seeds/sec",
                 "vs_baseline": round(seeds_per_sec / per_chip_target, 3),
                 **({"budget": budget} if budget else {}),
+                # this capture's history row (BENCH_HISTORY.jsonl —
+                # `python -m madsim_tpu bench report` renders the trend)
+                "history": {
+                    "tag": bench_tag,
+                    "path": os.path.basename(hist_path),
+                },
                 "platform": jax.devices()[0].platform,
                 "backend": _BACKEND_INFO,
                 # one-time compile vs steady state, split (a cold process
@@ -306,15 +412,7 @@ def main() -> None:
                 "steady_seeds_per_sec": round(seeds_per_sec, 1),
                 # active step-path gates: BENCH_r* files stay
                 # self-describing across this PR's flags
-                "gates": {
-                    "rng_stream": cfg.rng_stream,
-                    "clog_packed": cfg.clog_packed,
-                    "pallas_pop": eng.use_pallas_pop,
-                    "flight_recorder": cfg.flight_recorder,
-                    "coverage": cfg.coverage,
-                    "provenance": cfg.provenance,
-                    "compile_cache": active_compile_cache(),
-                },
+                "gates": gates,
                 "diagnostics": {
                     "reps": [round(x, 1) for x in rates],
                     "min": round(min(rates), 1),

@@ -21,6 +21,7 @@ from __future__ import annotations
 # metadata; nothing feeds simulation state. Virtual time lives in the
 # engine.
 import argparse
+import contextlib
 import dataclasses
 import json
 import logging
@@ -143,8 +144,19 @@ def build_machine(name: str, nodes: int = 0):
 
 
 def _build_engine(args):
-    from .engine import Engine, EngineConfig, FaultPlan
+    # engine construction (the engine/flax import chain, model init,
+    # device constants, first backend touch) lands on the host
+    # timeline: it is real wall time a --perf-timeline run would
+    # otherwise report as unattributed
+    from .perf.recorder import maybe_span
 
+    with maybe_span("engine_build"):
+        from .engine import Engine, EngineConfig, FaultPlan
+
+        return _build_engine_inner(args, Engine, EngineConfig, FaultPlan)
+
+
+def _build_engine_inner(args, Engine, EngineConfig, FaultPlan):
     machine = build_machine(args.machine, args.nodes)
     cfg = EngineConfig(
         # round, not truncate: a shrunk repro prints horizon_us/1e6 and
@@ -218,6 +230,49 @@ def _repro_line(args, seed) -> str:
         + ("--strict-restart " if getattr(args, "strict_restart", False) else "")
         + f"--max-steps {args.max_steps}"
     )
+
+
+@contextlib.contextmanager
+def _perf_session(args):
+    """`--perf-timeline PATH` / `--xla-profile DIR` wrapper around a
+    whole subcommand: a PerfRecorder publishes itself for the engine's
+    span instrumentation (madsim_tpu/perf/recorder.py) and the Chrome/
+    Perfetto host timeline + summary land AFTER the command's own
+    output; `--xla-profile` additionally wraps the run in
+    `jax.profiler.trace` (device/XLA-level profile for tensorboard).
+    The timeline is written even when the command fails — a failing
+    run's wall-clock profile is exactly what you want to look at."""
+    path = getattr(args, "perf_timeline", None)
+    xla_dir = getattr(args, "xla_profile", None)
+    if not path and not xla_dir:
+        yield None
+        return
+    rec = None
+    try:
+        with contextlib.ExitStack() as stack:
+            if xla_dir:
+                import jax
+
+                stack.enter_context(jax.profiler.trace(xla_dir))
+            if path:
+                from .perf.recorder import PerfRecorder
+
+                rec = stack.enter_context(
+                    PerfRecorder(meta={"cmd": getattr(args, "cmd", None)})
+                )
+            yield rec
+    finally:
+        if rec is not None and rec.wall_us:
+            n = rec.write(path)
+            s = rec.summary()
+            print(
+                f"host timeline: {n} spans, "
+                f"{100 * s['span_coverage']:.0f}% of {s['wall_s']:.1f}s "
+                f"wall attributed -> {path} (open in https://ui.perfetto.dev)"
+            )
+            print(f"host verdict: {rec.verdict()}")
+        if xla_dir:
+            print(f"xla profile -> {xla_dir} (tensorboard --logdir {xla_dir})")
 
 
 def _stream_kwargs(args) -> dict:
@@ -1287,7 +1342,117 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_perf(args) -> int:
+    """Host wall-clock observatory: run a streaming workload with the
+    PerfRecorder active (main() wires `args.perf_timeline = args.out`
+    before the command runs) and report what the wall clock went to —
+    compile vs blocked-on-device (counters_poll/ring_drain) vs the
+    host-side Python between dispatches. The Perfetto timeline +
+    verdict print via the shared --perf-timeline epilogue."""
+    eng = _build_engine(args)
+    agg = _stream_batches(eng, args, purpose="perf")
+    st = agg["stats"]
+    el = agg["elapsed_s"]
+    print(
+        f"streamed {agg['completed']} seeds in {el:.1f}s "
+        f"({agg['completed'] / max(el, 1e-9):.0f} seeds/s), "
+        f"{len(agg['failing'])} failing"
+    )
+    print(
+        f"executor: {st['device_segments']} segments, "
+        f"{st['host_syncs']} host syncs, {st['drains']} drains "
+        f"(pipelined={st['pipelined']}, donation={st['donation']})"
+    )
+    if "device_memory" in st:
+        mem = st["device_memory"]
+        print(
+            "device memory: "
+            + ", ".join(f"{k}={v}" for k, v in sorted(mem.items()))
+        )
+    return 0
+
+
+_AB_GATES = ("flight_recorder", "coverage", "provenance", "clog-packed",
+             "rng-stream")
+
+
+def cmd_bench_ab(args) -> int:
+    """Interleaved A/B cost of ONE engine gate: ABAB… alternating reps
+    in one process over identical seed ranges, median of PAIRED deltas
+    with a seeded-bootstrap 95% CI and an exact sign test
+    (madsim_tpu/perf/ab.py) — the protocol that replaced the one-rep
+    step_cost after it misread the provenance gate by 13x on this
+    drifting box (PR 7's receipt: 8% single-rep vs 0.61% interleaved).
+    Prints one JSON line + a human summary."""
+    import jax
+
+    from .engine import Engine
+    from .perf.ab import interleaved_ab
+    from .perf.recorder import current_recorder
+
+    eng = _build_engine(args)
+    base = eng.config
+    if args.gate == "rng-stream":
+        cfg_a = dataclasses.replace(base, rng_stream=3)
+        cfg_b = dataclasses.replace(base, rng_stream=2)
+        label_a, label_b = "rng_stream=3", "rng_stream=2"
+    else:
+        field = args.gate.replace("-", "_")
+        cfg_a = dataclasses.replace(base, **{field: True})
+        cfg_b = dataclasses.replace(base, **{field: False})
+        label_a, label_b = f"{field}=on", f"{field}=off"
+    lanes = args.lanes or 1024
+    n_rep = args.seeds or 2 * lanes
+    sk = _stream_kwargs(args)
+    runs = {}
+    for tag, cfg in (("a", cfg_a), ("b", cfg_b)):
+        run = Engine(eng.machine, cfg).make_stream_runner(
+            batch=lanes, segment_steps=384, max_steps=args.max_steps, **sk
+        )
+        # compile + one full untimed rep: the harness measures steady
+        # state, never compilation or a cold first rep
+        run(1)
+        run(n_rep, seed_start=500_000)
+        runs[tag] = run
+
+    res = interleaved_ab(
+        lambda s: runs["a"](n_rep, seed_start=s)["completed"],
+        lambda s: runs["b"](n_rep, seed_start=s)["completed"],
+        pairs=args.reps,
+        seed_start=args.seed,
+        seeds_per_rep=4 * n_rep,
+        label_a=label_a,
+        label_b=label_b,
+        recorder=current_recorder(),
+    )
+    print(json.dumps({
+        "metric": f"{args.gate}_ab_delta_pct",
+        "gate": args.gate,
+        "machine": args.machine,
+        "platform": jax.devices()[0].platform,
+        "lanes": lanes,
+        "seeds_per_rep": n_rep,
+        **res.to_dict(),
+    }))
+    print(res.summary())
+    return 0
+
+
+def _cmd_bench_report(args) -> int:
+    """`bench report`: render the BENCH_HISTORY.jsonl trend (seeding it
+    from the legacy BENCH_r*.json series when absent). Pure stdlib — no
+    jax, works on a box with no accelerator stack."""
+    from .perf import history
+
+    path = args.history or history.DEFAULT_BASENAME
+    rows = history.load_or_seed(path)
+    print(history.render_report(rows))
+    return 0
+
+
 def cmd_bench(args) -> int:
+    if getattr(args, "action", None) == "report":
+        return _cmd_bench_report(args)
     if args.lanes < 0 or args.reps < 1 or args.seeds < 1:
         sys.exit("bench needs --lanes >= 1 (or 0 = default), --reps >= 1, --seeds >= 1")
     if not getattr(args, "machine", None):
@@ -1473,6 +1638,22 @@ def main(argv=None) -> int:
             "resumable via --checkpoint; CI's interrupt/resume smoke and "
             "'hunt in slices' both use this)",
         )
+        p.add_argument(
+            "--perf-timeline", default=None, metavar="PATH",
+            help="record the HOST wall-clock timeline of this run "
+            "(compile/dispatch/counters_poll/ring_drain/checkpoint/"
+            "stats spans + dispatch-gap idle accounting) as Chrome/"
+            "Perfetto trace_event JSON at PATH, with a bound verdict "
+            "(compile- vs device- vs dispatch-gap-bound) printed after "
+            "the run — the real-time complement of `trace`'s "
+            "virtual-time view",
+        )
+        p.add_argument(
+            "--xla-profile", default=None, metavar="DIR",
+            help="additionally wrap the run in jax.profiler.trace(DIR) "
+            "— a device/XLA-level profile for tensorboard/xprof "
+            "(heavier than --perf-timeline; opt-in)",
+        )
 
     p = sub.add_parser("explore", help="run a seed batch, report failing seeds")
     common(p)
@@ -1626,16 +1807,70 @@ def main(argv=None) -> int:
     p = sub.add_parser(
         "bench",
         help="flagship benchmark (one JSON line); with --machine, a "
-        "streaming throughput bench of any registered machine",
+        "streaming throughput bench of any registered machine; "
+        "`bench report` renders the BENCH_HISTORY.jsonl trend (jax-free)",
     )
     common(p)  # one source of truth for the engine flags
+    p.add_argument(
+        "action", nargs="?", choices=("report",), default=None,
+        help="report: render the drift-aware bench history trend "
+        "(per-capture delta vs its own comparable neighbor — same "
+        "platform/lanes/gates/host; seeds the history from the legacy "
+        "BENCH_r*.json series on first use)",
+    )
     p.add_argument("--lanes", type=int, default=0)
     p.add_argument("--seeds", type=int, default=16384, help="seeds per rep")
     p.add_argument("--reps", type=int, default=3)
+    p.add_argument(
+        "--history", default=None, metavar="PATH",
+        help="bench history JSONL to render/append "
+        "(default ./BENCH_HISTORY.jsonl)",
+    )
     stream_flags(p)
     # bench-specific defaults: no machine = the flagship bench.py, and
     # timed seed ranges start clear of the validation sweeps
     p.set_defaults(fn=cmd_bench, machine=None, seed=1_000_000)
+
+    p = sub.add_parser(
+        "bench-ab",
+        help="interleaved A/B cost of one engine gate: ABAB… paired "
+        "reps over identical seed ranges in one process; median paired "
+        "delta with bootstrap 95%% CI + sign test (one JSON line). The "
+        "drift-robust replacement for single-rep gate costing",
+    )
+    common(p)
+    p.add_argument(
+        "--gate", required=True, choices=_AB_GATES,
+        help="the gate to cost: A runs it on, B off (rng-stream: "
+        "A=v3 vs B=v2); every other engine flag comes from the usual "
+        "options, so you can cost a gate on top of any configuration",
+    )
+    p.add_argument("--lanes", type=int, default=1024, help="lanes per streaming batch")
+    p.add_argument(
+        "--seeds", type=int, default=0,
+        help="seeds per rep (0 = 2*lanes)",
+    )
+    p.add_argument(
+        "--reps", type=int, default=4, metavar="PAIRS",
+        help="A/B rep PAIRS (4 pairs ≈ the PR-7 hand protocol; 2 is "
+        "the CI smoke minimum)",
+    )
+    stream_flags(p)
+    p.set_defaults(fn=cmd_bench_ab, seed=3_000_000)
+
+    p = sub.add_parser(
+        "perf",
+        help="host wall-clock observatory: stream a workload with the "
+        "PerfRecorder on and write the Perfetto host timeline "
+        "(compile/dispatch/poll/drain spans + dispatch-gap idle), with "
+        "a compile- vs device- vs dispatch-gap-bound verdict",
+    )
+    common(p)
+    p.add_argument("out", help="host-timeline Perfetto JSON output path")
+    p.add_argument("--seeds", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=512, help="lanes per streaming batch")
+    stream_flags(p)
+    p.set_defaults(fn=cmd_perf)
 
     p = sub.add_parser(
         "coverage",
@@ -1717,6 +1952,14 @@ def main(argv=None) -> int:
             getattr(args, "log_level", None) or "INFO",
             jsonl_path=getattr(args, "log_jsonl", None),
         )
+    if args.cmd == "perf":
+        # the out positional IS the host timeline: cmd_perf runs under
+        # the same --perf-timeline session as explore/hunt/bench
+        args.perf_timeline = args.out
+    jax_free = args.cmd in ("serve", "coverage", "lint") or (
+        # `bench report` renders history with no jax import at all
+        args.cmd == "bench" and getattr(args, "action", None) == "report"
+    )
     if getattr(args, "multihost", False):
         # distributed init must precede ANY backend access — including
         # the watchdog's own device probe, which would pin a
@@ -1724,12 +1967,13 @@ def main(argv=None) -> int:
         from .parallel import multihost
 
         multihost.initialize()
-    elif args.cmd not in ("serve", "coverage", "lint"):  # no jax — skip the probe
+    elif not jax_free:
         from ._backend_watchdog import ensure_live_backend
 
         cli_args = list(argv) if argv is not None else sys.argv[1:]
         ensure_live_backend(argv=["-m", "madsim_tpu"] + cli_args)
-    return args.fn(args)
+    with _perf_session(args):
+        return args.fn(args)
 
 
 if __name__ == "__main__":

@@ -39,6 +39,12 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
         return _active_dir
     import jax
 
+    # cache wiring lands on the host timeline (madsim_tpu/perf) so a
+    # --perf-timeline run shows whether its compiles could hit a
+    # persistent cache at all
+    from .perf.recorder import maybe_count
+
+    maybe_count("compile_cache_enabled")
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # cache every compile, not just the multi-second ones: a hunt's many

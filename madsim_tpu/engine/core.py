@@ -25,6 +25,7 @@ the lane seed.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from functools import partial
 from typing import Any, Optional, Tuple
@@ -1930,7 +1931,27 @@ class Engine:
         cache[key] = fns
         return fns
 
-    def run_stream(
+    def run_stream(self, n_seeds: int, **kwargs):
+        """See `_run_stream_impl` (the real docstring). This wrapper
+        puts the WHOLE streaming call on the host timeline as one
+        outer `run_stream` span when a PerfRecorder is active: on a
+        host that shares cores with the XLA compute threads (the
+        1-core CPU reference box), device execution shows up as the
+        host thread being starved at arbitrary points BETWEEN the
+        inner spans — the outer span captures it, and the recorder
+        reports it as `device_wait` (outer-span time not covered by
+        any inner span) instead of losing it to unattributed gaps."""
+        from ..perf.recorder import current_recorder
+
+        perf = current_recorder()
+        if perf is None:
+            return self._run_stream_impl(n_seeds, **kwargs)
+        with perf.span(
+            "run_stream", n_seeds=n_seeds, batch=kwargs.get("batch", 1024)
+        ):
+            return self._run_stream_impl(n_seeds, **kwargs)
+
+    def _run_stream_impl(
         self,
         n_seeds: int,
         batch: int = 1024,
@@ -2010,7 +2031,6 @@ class Engine:
             from ..parallel import shard_seeds
 
             seeds = shard_seeds(seeds, mesh)  # validates mesh axis + batch
-        carry = init_carry(seeds)
 
         failing: list = []
         infra: list = []
@@ -2034,7 +2054,27 @@ class Engine:
         # loud with the attempt count. Counted in stats.
         from .._backend_watchdog import retry_transient
 
-        def _dispatch(what, fn, *fn_args):
+        # Host-timeline tracing (madsim_tpu/perf): when a PerfRecorder
+        # is active in this context (--perf-timeline / `perf`), every
+        # dispatch/poll/drain below lands on the host timeline as a
+        # span. Pure host-side wall-clock accounting — no RNG words, no
+        # device-visible values, so streams are untouched by
+        # construction. `perf_warmed` tracks which jitted streaming fns
+        # this engine has already invoked: the FIRST call of a jitted
+        # fn traces + compiles synchronously before the async dispatch,
+        # so it is labelled "compile" (near-zero wall on a warm
+        # persistent cache), later calls "dispatch"/"init".
+        from ..perf.recorder import current_recorder
+
+        perf = current_recorder()
+        perf_warmed = self.__dict__.setdefault("_perf_warmed", set())
+
+        def _span_name(fn, hot_name):
+            # membership by object identity — the jitted fns are cached
+            # on the engine, so the set holds no extra lifetime
+            return "compile" if fn not in perf_warmed else hot_name
+
+        def _dispatch(what, fn, *fn_args, span=None):
             def on_retry(attempt, exc, delay_s):
                 stats["dispatch_retries"] += 1
                 import logging
@@ -2044,9 +2084,20 @@ class Engine:
                     "in %.2fs): %s", what, attempt, delay_s, exc,
                 )
 
-            return retry_transient(
-                lambda: fn(*fn_args), what=what, on_retry=on_retry
-            )
+            if perf is None:
+                return retry_transient(
+                    lambda: fn(*fn_args), what=what, on_retry=on_retry
+                )
+            with perf.span(span or what):
+                return retry_transient(
+                    lambda: fn(*fn_args), what=what, on_retry=on_retry
+                )
+
+        carry = _dispatch(
+            "carry init", init_carry, seeds,
+            span=_span_name(init_carry, "init"),
+        )
+        perf_warmed.add(init_carry)
 
         def drain(c: StreamCarry) -> StreamCarry:
             f_seeds, f_codes, f_provs, f_n, a_seeds, a_n = _dispatch(
@@ -2054,6 +2105,7 @@ class Engine:
                 jax.device_get,
                 (c.fail_seeds, c.fail_codes, c.fail_provs, c.fail_count,
                  c.ab_seeds, c.ab_count),
+                span="ring_drain",
             )
             stats["drains"] += 1
             stats["host_syncs"] += 1
@@ -2069,11 +2121,21 @@ class Engine:
                 if self.config.provenance:
                     prov_by_seed[int(s)] = int(f_provs[i])
             abandoned.extend(int(s) for s in a_seeds[: int(a_n)])
-            return reset_rings(c)
+            reset = _dispatch(
+                "ring reset", reset_rings, c,
+                span=_span_name(reset_rings, "dispatch"),
+            )
+            perf_warmed.add(reset_rings)
+            return reset
 
         def poll(c: StreamCarry):
             """The blocking device->host sync: one small counters read."""
-            counters = np.asarray(_dispatch("counters poll", jax.device_get, c.counters))
+            counters = np.asarray(
+                _dispatch(
+                    "counters poll", jax.device_get, c.counters,
+                    span="counters_poll",
+                )
+            )
             stats["host_syncs"] += 1
             if counters[4]:
                 raise RuntimeError(
@@ -2098,7 +2160,11 @@ class Engine:
             while completed < n_seeds and stats["dispatches"] < max_dispatch:
                 # async dispatch: returns immediately, device work queues
                 # behind the donated carry chain
-                carry = _dispatch("supersegment dispatch", supersegment, carry, need)
+                carry = _dispatch(
+                    "supersegment dispatch", supersegment, carry, need,
+                    span=_span_name(supersegment, "dispatch"),
+                )
+                perf_warmed.add(supersegment)
                 stats["dispatches"] += 1
                 in_flight += 1
                 if in_flight >= dispatch_depth:
@@ -2113,7 +2179,11 @@ class Engine:
         else:
             # r5 executor: one blocking counters read per segment
             while completed < n_seeds and stats["dispatches"] < max_segments:
-                carry = _dispatch("segment dispatch", segment, carry)
+                carry = _dispatch(
+                    "segment dispatch", segment, carry,
+                    span=_span_name(segment, "dispatch"),
+                )
+                perf_warmed.add(segment)
                 stats["dispatches"] += 1
                 counters = poll(carry)
                 completed = int(counters[0])
@@ -2130,11 +2200,9 @@ class Engine:
             # one extra small transfer, after streaming is over
             from ..runtime.metrics import fr_metrics_dict
 
-            fr_stats = {
-                "flight_recorder": fr_metrics_dict(
-                    jax.device_get(carry.fr_metrics)
-                )
-            }
+            with (perf.span("harvest") if perf else contextlib.nullcontext()):
+                fr_vec = jax.device_get(carry.fr_metrics)
+            fr_stats = {"flight_recorder": fr_metrics_dict(fr_vec)}
         cov_stats = {}
         cov_map_np = None
         if self.config.coverage:
@@ -2143,8 +2211,10 @@ class Engine:
             # form every host-side consumer reads
             from ..runtime.coverage import coverage_dict, unpack_map
 
+            with (perf.span("harvest") if perf else contextlib.nullcontext()):
+                cov_words = jax.device_get(carry.cov_map)
             cov_map_np = unpack_map(
-                np.asarray(jax.device_get(carry.cov_map)),
+                np.asarray(cov_words),
                 self.config.cov_slots_log2,
             )
             cov_stats = {
@@ -2156,6 +2226,30 @@ class Engine:
                     "curve": cov_curve,
                 }
             }
+        # Device-memory high-water accounting: backends that implement
+        # memory_stats (TPU, some GPU builds; CPU returns None) report
+        # peak/live HBM for the device the stream ran on. Read only
+        # under an active PerfRecorder — one host call, zero device
+        # work — and surfaced in stats so the timeline's "is this run
+        # memory-pressured" question has an answer next to it.
+        mem_stats = {}
+        if perf is not None:
+            try:
+                m = jax.local_devices()[0].memory_stats()
+            except Exception:  # backend without the API
+                m = None
+            if m:
+                mem_stats = {
+                    "device_memory": {
+                        k: int(m[k])
+                        for k in (
+                            "peak_bytes_in_use", "bytes_in_use", "bytes_limit"
+                        )
+                        if k in m
+                    }
+                }
+                perf.count("device_peak_bytes",
+                           int(m.get("peak_bytes_in_use", 0)))
         out = {
             "completed": int(counters[0]),
             "failing": failing,
@@ -2169,6 +2263,7 @@ class Engine:
                 "segments_per_dispatch": segments_per_dispatch if pipelined else 1,
                 "donation": bool(donate),
                 "pipelined": bool(pipelined),
+                **mem_stats,
                 **fr_stats,
                 **cov_stats,
             },

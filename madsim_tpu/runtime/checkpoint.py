@@ -51,13 +51,18 @@ def fingerprint_from_args(args) -> dict:
 
 def save_checkpoint(path: str, state: dict) -> None:
     """Atomic write (tmp + rename): a kill mid-write leaves the previous
-    checkpoint intact, never a truncated JSON."""
+    checkpoint intact, never a truncated JSON. Rides the host timeline
+    as a `checkpoint_write` span when a PerfRecorder is active —
+    per-batch persistence is part of the wall-clock budget."""
+    from ..perf.recorder import maybe_span
+
     doc = {"version": CKPT_VERSION, **state}
     tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f, indent=1, sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, path)
+    with maybe_span("checkpoint_write"):
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
 
 
 def load_checkpoint(path: str) -> Optional[dict]:

@@ -151,10 +151,19 @@ class StatsEmitter:
 
     def emit(self, record: dict) -> dict:
         """Emit one record (a plain dict of stats). Returns the record
-        as written (with `ts`/`seq` stamped)."""
+        as written (with `ts`/`seq` stamped). The write rides the host
+        timeline as a `stats_emit` span when a PerfRecorder is active
+        (madsim_tpu/perf) — emitter I/O is part of the observability
+        tax the timeline exists to expose."""
+        from .perf.recorder import maybe_span
+
         self.seq += 1
         # madsim: allow(D001) — JSONL sink stamps host wall time
         row = {"ts": round(time.time(), 6), "seq": self.seq, **record}
+        with maybe_span("stats_emit"):
+            return self._emit_row(row)
+
+    def _emit_row(self, row: dict) -> dict:
         try:
             self._jsonl.write(json.dumps(row, sort_keys=True) + "\n")
             self._jsonl.flush()

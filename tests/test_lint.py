@@ -183,6 +183,30 @@ def test_whole_package_self_run_clean():
     assert rc == 0
 
 
+def test_perf_package_self_lints_clean():
+    """The perf package's CONTRACT is reading the wall clock (host
+    timelines, A/B rep timing, history timestamps) — exactly what D001
+    bans elsewhere. Its modules carry file-level allowances with a
+    written justification, and the package must lint clean (rc 0) so
+    the whole-package gate above keeps holding with perf/ present."""
+    perf_dir = os.path.join(REPO, "madsim_tpu", "perf")
+    rc = lint_main(ns(paths=[perf_dir]))
+    assert rc == 0
+    # the suppressions are file-level and deliberate — each module
+    # justifies its wall-clock contract next to the allowance (the
+    # justification comment is part of the hygiene bar, not optional)
+    for fname in ("recorder.py", "ab.py", "history.py"):
+        with open(os.path.join(perf_dir, fname)) as f:
+            src = f.read()
+        assert "madsim: allow-file(D001)" in src, fname
+        allow_line = [
+            l for l in src.splitlines() if "allow-file(D001)" in l
+        ][0]
+        assert "—" in allow_line or "--" in allow_line, (
+            f"{fname}: allow-file needs its justification on the line"
+        )
+
+
 # -- suppressions + baseline -------------------------------------------------
 
 
