@@ -1972,6 +1972,17 @@ def main(argv=None) -> int:
 
         cli_args = list(argv) if argv is not None else sys.argv[1:]
         ensure_live_backend(argv=["-m", "madsim_tpu"] + cli_args)
+    if not jax_free:
+        # Warm-start priming: wire the persistent compilation cache
+        # (--compile-cache / $MADSIM_TPU_COMPILE_CACHE) BEFORE the
+        # subcommand's first jit, so hunt/explore/bench-ab warmups
+        # read and write the cache from their very first compile —
+        # enabling is first-directory-wins per process, and an engine
+        # constructed before the cache was bound would pay a full
+        # cold build that the fleet then never reuses.
+        from .compile_cache import enable_compile_cache
+
+        enable_compile_cache(getattr(args, "compile_cache", None))
     with _perf_session(args):
         return args.fn(args)
 

@@ -1,4 +1,4 @@
-"""Opt-in JAX persistent compilation cache wiring.
+"""Opt-in JAX persistent compilation cache wiring (+ warm-start keys).
 
 PROFILE_r5 measured multi-second `lane_step` / streaming-executor
 recompiles paid once per *process*; hunts, sweeps and CI shards spawn
@@ -12,31 +12,138 @@ The cache is keyed by (HLO, jaxlib version, XLA flags, device kind), so
 it is safe to share a directory across configs and machines of the same
 software image; a mismatched key is simply a miss. Works on CPU, GPU and
 TPU backends with current jaxlib.
+
+Warm-start discipline (r11): jax's internal key makes sharing SAFE but
+says nothing about what a given worker will actually *hit* — a fleet
+primes per-(jax version, gate tuple, stream version, shape) so a cold
+worker's first compile is a deserialize, not a build. `cache_subkey`
+renders exactly that tuple as a directory-name-safe string; bench.py
+routes its cache under it and reports `compile_s_cold` vs
+`compile_s_warm` (the warm number is measured by dropping the
+in-process jit caches and recompiling against the just-written
+persistent entries — the path every warm fleet worker takes). CI keys
+its actions/cache on the same string.
+
+Failure discipline: `enable_compile_cache` used to degrade silently
+when the directory could not be created or written — a fleet that
+*thinks* it is warm but recompiles everywhere is the worst of both
+worlds. It now probes writability: `strict=True` (bench, priming jobs)
+raises; the default logs a warning and leaves the cache off.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import re
 from typing import Optional
 
 _active_dir: Optional[str] = None
 
+_log = logging.getLogger("madsim_tpu.compile_cache")
 
-def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+
+def cache_subkey(
+    *,
+    gates: Optional[dict] = None,
+    rng_stream: Optional[int] = None,
+    lanes: Optional[int] = None,
+    segment_steps: Optional[int] = None,
+) -> str:
+    """A directory-name-safe warm-start key: (jax/jaxlib version, gate
+    tuple, stream version, shape key). Two processes with equal subkeys
+    compile byte-identical HLO for the streaming path, so priming one
+    warms the other; anything that changes the compiled step (a jax
+    upgrade, a gate flip, a new lane count) lands in its own
+    subdirectory instead of growing one stale shared pile forever.
+
+    `gates` is the bench-style dict ({"rng_stream": 3, "coverage":
+    True, ...}); bool values render as 0/1, the rest as-is. Unknown /
+    None fields are simply omitted — the key is best-effort
+    discrimination, jax's internal (HLO, jaxlib, flags, device) key is
+    what guarantees correctness."""
+    try:
+        import jax
+        import jaxlib
+
+        parts = [f"jax{jax.__version__}-jaxlib{jaxlib.__version__}"]
+    except Exception:  # pragma: no cover - jax-free callers
+        parts = ["jax-unknown"]
+    if rng_stream is not None:
+        parts.append(f"rng{rng_stream}")
+    if gates:
+        bits = []
+        for k in sorted(gates):
+            v = gates[k]
+            if v is None:
+                continue
+            short = "".join(w[0] for w in k.split("_")) or k
+            bits.append(f"{short}{int(v) if isinstance(v, bool) else v}")
+        if bits:
+            parts.append(".".join(bits))
+    if lanes is not None:
+        shape = f"l{lanes}"
+        if segment_steps is not None:
+            shape += f"x{segment_steps}"
+        parts.append(shape)
+    return re.sub(r"[^A-Za-z0-9._-]", "_", "-".join(parts))
+
+
+def _probe_writable(path: str) -> Optional[str]:
+    """Create `path` and prove a write lands. Returns an error string
+    instead of raising (the caller decides strict vs warn). A plain
+    os.access check is not enough: this repo's CI and the reference box
+    run as root, where access() says yes to read-only mounts."""
+    try:
+        os.makedirs(path, exist_ok=True)
+        probe = os.path.join(path, ".madsim-tpu-write-probe")
+        with open(probe, "w") as f:
+            f.write("ok")
+        os.remove(probe)
+    except OSError as e:
+        return f"{type(e).__name__}: {e}"
+    return None
+
+
+def enable_compile_cache(
+    path: Optional[str] = None,
+    *,
+    strict: bool = False,
+    subdir: Optional[str] = None,
+) -> Optional[str]:
     """Enable the JAX persistent compilation cache.
 
     `path` falls back to $MADSIM_TPU_COMPILE_CACHE; with neither set
-    this is a no-op returning None. Idempotent — the first directory
-    wins for the process (jax's cache is global); later calls with a
-    different directory return the ACTIVE one rather than silently
-    rebinding half the jit cache. Returns the active directory."""
+    this is a no-op returning None. `subdir` (usually a `cache_subkey`)
+    nests the cache under the base path — pick it BEFORE the first jit,
+    because enabling is idempotent: the first directory wins for the
+    process (jax's cache is global); later calls with a different
+    directory return the ACTIVE one rather than silently rebinding half
+    the jit cache. Returns the active directory.
+
+    An unwritable directory raises RuntimeError under `strict` and
+    logs a warning (cache left off) otherwise — never the old silent
+    no-op that let a fleet believe it was warm while every worker
+    recompiled."""
     global _active_dir
     path = path or os.environ.get("MADSIM_TPU_COMPILE_CACHE")
     if not path:
         return _active_dir
     path = os.path.abspath(os.path.expanduser(path))
+    if subdir:
+        path = os.path.join(path, subdir)
     if _active_dir is not None:
         return _active_dir
+    err = _probe_writable(path)
+    if err is not None:
+        msg = (
+            f"compile cache directory {path!r} is not writable ({err}); "
+            f"every process will silently recompile"
+        )
+        if strict:
+            raise RuntimeError(msg)
+        _log.warning("%s — persistent cache left DISABLED", msg)
+        return None
     import jax
 
     # cache wiring lands on the host timeline (madsim_tpu/perf) so a
@@ -45,7 +152,6 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
     from .perf.recorder import maybe_count
 
     maybe_count("compile_cache_enabled")
-    os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # cache every compile, not just the multi-second ones: a hunt's many
     # small jits (replay steps, shrink candidates) add up too. -1 on the
@@ -69,3 +175,23 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
 def active_compile_cache() -> Optional[str]:
     """The directory enabled for this process, or None."""
     return _active_dir
+
+
+def measure_warm_compile(build_and_run) -> Optional[float]:
+    """Time the WARM compile path: drop every in-process jit cache,
+    then run `build_and_run` (which must construct fresh jitted
+    callables and invoke them once) against the persistent entries the
+    cold path just wrote — the exact path a new fleet worker or a
+    post-restart replay pays. Returns seconds, or None when no
+    persistent cache is active (there is no warm path to measure; the
+    honest answer is "same as cold", not a fabricated number)."""
+    if _active_dir is None:
+        return None
+    import time
+
+    import jax
+
+    jax.clear_caches()
+    t0 = time.perf_counter()  # madsim: allow(D001) — host-side timing
+    build_and_run()
+    return time.perf_counter() - t0  # madsim: allow(D001)

@@ -49,7 +49,12 @@ from ..ops.coverage import (
     cov_slot,
     empty_cov_map,
 )
-from ..ops.pallas_pop import HAVE_PALLAS, pop_earliest_batch, pop_gather_batch
+from ..ops.pallas_pop import (
+    HAVE_PALLAS,
+    pop_earliest_batch,
+    pop_gather_batch,
+    step_megakernel,
+)
 from ..ops.step_rng import (
     RNG_STREAM_COUNTER,
     RNG_STREAM_LEGACY,
@@ -459,6 +464,19 @@ class EngineConfig:
     # cone). Consumes NO RNG words; gate-off is bit-identical (tests
     # assert under both stream versions).
     provenance: bool = False
+    # Whole-event Pallas step megakernel (ops/pallas_pop.py): the
+    # model-independent prefix of the step — lexicographic-argmin pop,
+    # popped-tuple gather, the counter-based v3 RNG word block
+    # (in-kernel Threefry-2x32, bit-exact vs jax's primitive) and,
+    # under the flight recorder, the whole digest fold — fused into ONE
+    # VMEM pass per lane block. None = auto: ON when the backend is TPU
+    # and rng_stream is 3; MADSIM_TPU_PALLAS_MEGAKERNEL=0/1 forces
+    # either way. Requires rng_stream=3 (the word block IS the v3
+    # counter derivation). Pure fusion: results are bit-identical to
+    # the XLA path, which stays the oracle (tests assert end-to-end
+    # and per-kernel in interpreter mode). Host-side perf knob —
+    # excluded from corpus serialization like compile_cache_dir.
+    pallas_megakernel: Optional[bool] = None
     # Opt-in JAX persistent compilation cache directory (also
     # $MADSIM_TPU_COMPILE_CACHE): hunts and sweeps pay each multi-second
     # compile once per machine instead of once per process. Host-side
@@ -528,7 +546,7 @@ class StreamCarry:
     ab_seeds: jax.Array  # uint32[C]
     ab_count: jax.Array  # int32 scalar
     counters: jax.Array  # uint32[7]: completed, fail_count, ab_count, next_seed, flags, segments, cov_slots_hit
-    fr_metrics: jax.Array  # int32[FR_METRICS_LEN]: flight-recorder totals (zeros when off)
+    fr_metrics: jax.Array  # int32[FR_METRICS_LEN] flight-recorder totals ([0] when off)
     cov_map: jax.Array  # int32[2^cov_slots_log2 / 32] global OR of lane bit maps ([0] when off)
 
 
@@ -579,7 +597,36 @@ class Engine:
             else:
                 use_pallas_pop = env != "0"
         self.use_pallas_pop = bool(use_pallas_pop) and HAVE_PALLAS
-        if self.use_pallas_pop:
+        # Whole-event step megakernel (EngineConfig.pallas_megakernel /
+        # MADSIM_TPU_PALLAS_MEGAKERNEL): resolved like the pop kernel —
+        # auto means ON only on TPU — plus the static requirement that
+        # the stream is v3 (the kernel computes the counter-based word
+        # block; v2's split-chain key evolution is inherently
+        # sequential host..er, XLA-side). A forced-on megakernel off-TPU
+        # runs in interpreter mode (equivalence tests, not production).
+        mk = config.pallas_megakernel
+        if mk is None:
+            env_mk = os.environ.get("MADSIM_TPU_PALLAS_MEGAKERNEL", "")
+            if env_mk == "":
+                import jax as _jax
+
+                mk = _jax.default_backend() == "tpu"
+            else:
+                mk = env_mk != "0"
+            # auto/env resolution degrades gracefully on a v2 engine
+            # (legacy replays, shrink of recorded seeds): the kernel
+            # simply cannot serve that stream, so it stays off
+            mk = mk and config.rng_stream == RNG_STREAM_COUNTER
+        elif mk and config.rng_stream != RNG_STREAM_COUNTER:
+            # explicitly requested on a v2 engine is a config error
+            raise ValueError(
+                "pallas_megakernel requires rng_stream=3 (the kernel "
+                "computes the counter-based v3 word block in the same "
+                "VMEM pass as the pop; v2's per-step key split-chain "
+                "cannot be expressed as a counter)"
+            )
+        self.use_megakernel = bool(mk) and HAVE_PALLAS
+        if self.use_pallas_pop or self.use_megakernel:
             import jax as _jax
 
             self._pallas_interpret = _jax.default_backend() != "tpu"
@@ -887,7 +934,7 @@ class Engine:
             ),
             nodes=nodes,
             ring=self._empty_ring(),
-            fr=self._empty_fr(),
+            fr=self._empty_fr(eq_valid),
             cov=self._empty_cov(),
         )
 
@@ -897,9 +944,17 @@ class Engine:
             return {}
         return {"map": empty_cov_map(self.config.cov_slots_log2)}
 
-    def _empty_fr(self):
+    def _empty_fr(self, eq_valid=None):
         """Fresh flight-recorder state: digest at its IV, empty
-        checkpoint ring (step -1 = unused slot), zeroed metrics."""
+        checkpoint ring (step -1 = unused slot), zeroed metrics.
+        `eq_valid` (the lane's initial queue-valid plane) seeds the
+        incremental occupancy counter `eq_n` — the step tracks queue
+        occupancy as (-1 per pop, +1 per push) instead of re-summing
+        the [Q] valid plane every event, so the q_hwm metric costs
+        O(1) per step. Value-identical to the old per-step sum by
+        construction (every pop clears exactly one valid slot, every
+        push fills exactly one free slot); the host-oracle metrics
+        differential asserts it."""
         cfg = self.config
         if not cfg.flight_recorder:
             return {}
@@ -907,6 +962,10 @@ class Engine:
         return {
             "d0": jnp.uint32(DIGEST_IV0),
             "d1": jnp.uint32(DIGEST_IV1),
+            "eq_n": (
+                jnp.int32(0) if eq_valid is None
+                else eq_valid.sum(dtype=jnp.int32)
+            ),
             "ck_step": jnp.full((r,), -1, jnp.int32),
             "ck_d0": jnp.zeros((r,), jnp.uint32),
             "ck_d1": jnp.zeros((r,), jnp.uint32),
@@ -939,7 +998,7 @@ class Engine:
 
     def _lane_step_popped(
         self, s: LaneState, idx, any_valid, popped=None, horizon_us=None,
-        active=None,
+        active=None, step_block=None,
     ) -> LaneState:
         """lane_step with the event-queue pop hoisted out, so step_batch
         can swap in the batched Pallas kernel for the whole [L, Q] block
@@ -962,7 +1021,15 @@ class Engine:
         `horizon_us` optionally overrides the config horizon with a
         TRACED value — identical arithmetic, but one compiled replay
         serves every horizon candidate (shrink bisects the horizon
-        per-seed; baking it would recompile per candidate)."""
+        per-seed; baking it would recompile per candidate).
+
+        `step_block`, when given, is the megakernel's precomputed
+        `(words,)` or `(words, nd0, nd1)` tuple — the v3 RNG word block
+        (and, under the flight recorder, the already-folded digest)
+        from the fused Pallas pass. The step then draws nothing and
+        folds nothing itself; values are bit-identical by the kernel
+        contract. Only meaningful on a v3 engine (the lane key is
+        immutable; the restart key is a block slice)."""
         m, cfg = self.machine, self.config
 
         if popped is None:
@@ -1032,7 +1099,19 @@ class Engine:
         # counter-based off the immutable lane key and the step index
         # (ONE threefry invocation, block sized to the enabled config).
         layout = self._rng_layout
-        key, step_words, k_restart = draw_step_words(s.rng_key, s.step, layout)
+        if step_block is None:
+            key, step_words, k_restart = draw_step_words(s.rng_key, s.step, layout)
+        else:
+            # megakernel path: the word block arrived from the fused
+            # Pallas pass. v3 semantics exactly — the lane key is
+            # immutable and the restart key is the block's restart
+            # slice (step_words_v3's contract).
+            step_words = step_block[0]
+            key = s.rng_key
+            if layout.restart_off is not None:
+                k_restart = step_words[layout.restart_off : layout.restart_off + 2]
+            else:
+                k_restart = jnp.zeros((2,), jnp.uint32)
         rand_u32 = step_words[: layout.handler_words]
         if active is not None and layout.version == RNG_STREAM_LEGACY:
             # v2's key evolves per step — freeze it with the lane
@@ -1445,14 +1524,19 @@ class Engine:
             # digest: fold the popped tuple + the step's whole RNG word
             # block — exactly the inputs that determine this step — on
             # every step that pops an event (same condition as the trace
-            # ring / replay trace)
-            nd0, nd1 = digest_fold(
-                fr["d0"],
-                fr["d1"],
-                [ev_time, ev_kind, ev_node, ev_src]
-                + [ev_payload[i] for i in range(m.PAYLOAD_WIDTH)]
-                + [step_words[i] for i in range(layout.total_words)],
-            )
+            # ring / replay trace). The megakernel hands the fold in
+            # pre-computed (same words, same order, same math — the
+            # fused pass runs the identical chain in VMEM).
+            if step_block is not None and len(step_block) == 3:
+                nd0, nd1 = step_block[1], step_block[2]
+            else:
+                nd0, nd1 = digest_fold(
+                    fr["d0"],
+                    fr["d1"],
+                    [ev_time, ev_kind, ev_node, ev_src]
+                    + [ev_payload[i] for i in range(m.PAYLOAD_WIDTH)]
+                    + [step_words[i] for i in range(layout.total_words)],
+                )
             d0 = jnp.where(live, nd0, fr["d0"])
             d1 = jnp.where(live, nd1, fr["d1"])
             # checkpoint ring: every `fr_digest_every`-th step the lane
@@ -1482,7 +1566,21 @@ class Engine:
                     process & (ev_kind == EV_FAULT) & (ev_payload[0] == F_RESTART)
                 ).astype(jnp.int32)
             # occupancy high-water marks on the post-step state (frozen
-            # lanes' state is unchanged, so their marks are stable)
+            # lanes' state is unchanged, so their marks are stable).
+            # Queue occupancy is tracked INCREMENTALLY: the pop clears
+            # exactly one valid slot (when live and not deferred) and
+            # every successful push — messages, duplicates, timers, the
+            # restart boot — fills exactly one free slot and bumped
+            # next_seq, so the delta is (next_seq' - next_seq) minus the
+            # pop. Replaces a [Q]-wide re-sum of eq["valid"] per event
+            # with three scalar ops; equal to the old sum by
+            # construction (host-oracle differential asserts it).
+            popped_one = live if defer is None else (live & ~defer)
+            eq_n = (
+                fr["eq_n"]
+                - popped_one.astype(jnp.int32)
+                + (next_seq - s.next_seq)
+            )
             n_clog = (
                 lax.population_count(clogged).sum()
                 if cfg.clog_packed
@@ -1491,15 +1589,14 @@ class Engine:
             fr = {
                 "d0": d0,
                 "d1": d1,
+                "eq_n": eq_n,
                 "ck_step": jnp.where(ck_slot, new_step, fr["ck_step"]),
                 "ck_d0": jnp.where(ck_slot, d0, fr["ck_d0"]),
                 "ck_d1": jnp.where(ck_slot, d1, fr["ck_d1"]),
                 "inj": inj,
                 "dup": fr_dup,
                 "amnesia": fr_amnesia,
-                "q_hwm": jnp.maximum(
-                    fr["q_hwm"], eq["valid"].sum().astype(jnp.int32)
-                ),
+                "q_hwm": jnp.maximum(fr["q_hwm"], eq_n),
                 "clog_hwm": jnp.maximum(fr["clog_hwm"], n_clog),
                 "kill_hwm": jnp.maximum(
                     fr["kill_hwm"], killed.sum().astype(jnp.int32)
@@ -1630,6 +1727,27 @@ class Engine:
         # (`active=`) instead of a post-hoc tree_where that re-selected
         # every [L, Q] queue leaf and the whole nodes tree each step
         active = ~(state.done | state.failed)
+        if self.use_megakernel:
+            # whole-event megakernel: pop + gather + the v3 RNG block
+            # (+ the digest fold under the recorder) leave one fused
+            # VMEM pass; the rest of the step consumes them via
+            # step_block and draws/folds nothing itself
+            fr_on = self.config.flight_recorder
+            idx, any_valid, popped, words, digest = step_megakernel(
+                state.eq_time, state.eq_seq, state.eq_valid,
+                state.eq_kind, state.eq_node, state.eq_src, state.eq_payload,
+                state.rng_key, state.step, self._rng_layout.total_words,
+                d0=state.fr["d0"] if fr_on else None,
+                d1=state.fr["d1"] if fr_on else None,
+                digest_fold=digest_fold if fr_on else None,
+                interpret=self._pallas_interpret,
+            )
+            block = (words,) + digest
+            return jax.vmap(
+                lambda st, i, a, act, p, blk: self._lane_step_popped(
+                    st, i, a, popped=p, active=act, step_block=blk
+                )
+            )(state, idx, any_valid, active, popped, block)
         if self.use_pallas_pop:
             # fused pop+gather: the popped event tuple leaves the kernel
             # in the same VMEM pass as the argmin
@@ -1757,11 +1875,18 @@ class Engine:
                     c.next_seed,
                     over.astype(jnp.uint32),
                     c.segments.astype(jnp.uint32),
-                    # global coverage slots hit (0 when the gate is off —
-                    # the empty map popcounts to 0): rides the one small
+                    # global coverage slots hit: rides the one small
                     # counters transfer the host polls anyway, so the
-                    # live coverage curve costs zero extra syncs
-                    lax.population_count(c.cov_map).sum(dtype=jnp.uint32),
+                    # live coverage curve costs zero extra syncs. Gate
+                    # off = a literal zero — the popcount op itself is
+                    # specialized out of the lowered segment (the
+                    # gate-off HLO pin in tests/test_step_gates.py
+                    # string-matches its absence).
+                    (
+                        lax.population_count(c.cov_map).sum(dtype=jnp.uint32)
+                        if self.config.coverage
+                        else jnp.uint32(0)
+                    ),
                 ]
             )
 
@@ -1782,7 +1907,15 @@ class Engine:
                 ab_seeds=jnp.zeros((cap,), jnp.uint32),
                 ab_count=jnp.int32(0),
                 counters=jnp.zeros((7,), jnp.uint32),
-                fr_metrics=jnp.zeros((FR_METRICS_LEN,), jnp.int32),
+                # recorder off: a ZERO-LENGTH leaf, not a vector of
+                # zeros — the dead operand would otherwise ride the
+                # whole supersegment while_loop carry (the host-visible
+                # schema is unaffected: the stats dict synthesizes
+                # nothing unless the gate is on)
+                fr_metrics=jnp.zeros(
+                    (FR_METRICS_LEN if self.config.flight_recorder else 0,),
+                    jnp.int32,
+                ),
                 cov_map=(
                     empty_cov_map(self.config.cov_slots_log2)
                     if self.config.coverage

@@ -30,9 +30,13 @@ STATUS_FIXED = "fixed"  # bug resolved: entry must keep passing
 
 def config_to_dict(cfg: EngineConfig) -> dict:
     d = dataclasses.asdict(cfg)
-    # host-side knob, never trace-affecting: a corpus entry must replay
-    # on any machine, not name some other box's cache directory
+    # host-side knobs, never trace-affecting: a corpus entry must replay
+    # on any machine — not name some other box's cache directory, and
+    # not demand (or forbid) the fused step kernel its recording box
+    # happened to resolve (the megakernel is asserted bit-identical to
+    # the XLA oracle under its gate)
     d.pop("compile_cache_dir", None)
+    d.pop("pallas_megakernel", None)
     # the flight recorder is asserted bit-identical under its gate, so
     # entries don't record it: the digest trail lives in the entry's own
     # digests/digest_final fields, and the auditor re-enables the

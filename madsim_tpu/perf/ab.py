@@ -33,6 +33,17 @@ import statistics
 import time
 from typing import Callable, List, Sequence, Tuple
 
+# bench.py's default interleaved pair count per gate (MADSIM_TPU_BENCH_
+# AB_PAIRS overrides). Widened 2 -> 5 in r11: a 2-pair bootstrap CI is
+# the degenerate [min, max] of two deltas — r10's coverage gate read
+# -0.95% [CI -3.53, +8.63], a straddle no budget decision can stand on
+# — while 5 paired deltas give the median real resampling room (the CI
+# narrows roughly with sqrt(pairs), and 5 pairs = 10 alternating reps
+# keeps a 3-gate flagship breakdown under ~25 min on the reference
+# box). Pinned in tests/test_perf.py: changing it is a measurement-
+# protocol change and should look like one.
+DEFAULT_BENCH_AB_PAIRS = 5
+
 
 def sign_test_p(deltas: Sequence[float]) -> float:
     """Exact two-sided sign test p-value: probability under H0 (median

@@ -59,11 +59,15 @@ def env_fingerprint(
     reps: Optional[int] = None,
     segment_steps: Optional[int] = None,
     gates: Optional[dict] = None,
+    compile_cache: Optional[bool] = None,
 ) -> dict:
     """The comparability fingerprint for one bench capture. Versions
     are read from the installed packages; `backend_platform` is the
     jax device platform string ("cpu"/"tpu"/...), passed in so this
-    module stays jax-free."""
+    module stays jax-free. `compile_cache` records whether a
+    persistent compilation cache backed the capture — context for its
+    compile_s numbers, deliberately NOT part of the comparability key
+    (cache state never changes steady-state rate)."""
     try:
         import jax
         import jaxlib
@@ -81,6 +85,7 @@ def env_fingerprint(
         "reps": reps,
         "segment_steps": segment_steps,
         "gates": _norm_gates(gates),
+        "compile_cache": compile_cache,
     }
 
 
@@ -107,6 +112,7 @@ def make_record(
     *,
     reps: Optional[List[float]] = None,
     compile_s: Optional[float] = None,
+    compile_s_warm: Optional[float] = None,
     spread_pct: Optional[float] = None,
     host_load1: Optional[float] = None,
     step_cost: Optional[dict] = None,
@@ -119,7 +125,11 @@ def make_record(
         "ts": round(time.time(), 3) if ts is None else ts,
         "value": value,
         "reps": reps,
+        # compile_s = the cold number (first process of a config);
+        # compile_s_warm = the persistent-cache path (None when the
+        # capture ran without a cache — no warm path existed)
         "compile_s": compile_s,
+        "compile_s_warm": compile_s_warm,
         "spread_pct": spread_pct,
         "host_load1": host_load1,
         "step_cost": step_cost,

@@ -11,7 +11,14 @@ import jax.numpy as jnp
 import pytest
 
 from madsim_tpu.ops import pop_earliest
-from madsim_tpu.ops.pallas_pop import HAVE_PALLAS, pop_earliest_batch, pop_gather_batch
+from madsim_tpu.ops.pallas_pop import (
+    HAVE_PALLAS,
+    pop_earliest_batch,
+    pop_gather_batch,
+    step_megakernel,
+    step_rng_words_fused,
+    threefry2x32_pair,
+)
 
 pytestmark = pytest.mark.skipif(not HAVE_PALLAS, reason="pallas unavailable")
 
@@ -120,3 +127,119 @@ def test_pallas_pop_unaligned_lane_count():
     for lane in range(13):
         if bool(xla_any[lane]):
             assert int(xla_idx[lane]) == int(pl_idx[lane])
+
+
+# -- the whole-event step megakernel (r11) -----------------------------------
+
+
+def test_threefry_pair_matches_jax_primitive():
+    """The in-kernel Threefry-2x32 (threefry2x32_pair + the pad/split
+    packing in step_rng_words_fused) is bit-exact vs jax's fused
+    primitive for odd AND even block widths — this IS the v3 stream
+    contract: a single differing bit would silently re-derive every
+    word a megakernel step consumes."""
+    from jax.extend.random import threefry_2x32
+
+    for seed in range(4):
+        key = jax.random.PRNGKey(seed)
+        for w in (1, 2, 7, 10, 11, 21, 22, 30):
+            for step in (0, 3, 77, 123456):
+                counts = jnp.uint32(step) * jnp.uint32(w) + jnp.arange(
+                    w, dtype=jnp.uint32
+                )
+                ref = threefry_2x32(key, counts)
+                fused = step_rng_words_fused(
+                    key[None, :1].astype(jnp.uint32),
+                    key[None, 1:].astype(jnp.uint32),
+                    jnp.full((1, 1), step, jnp.uint32),
+                    w,
+                )[0]
+                assert ref.tolist() == fused.tolist(), (seed, w, step)
+
+
+def _oracle_step_prefix(arrs, keys, steps, w, d0=None, d1=None):
+    """The XLA composition the megakernel must match bit-for-bit:
+    pop+gather, then step_words_v3 per lane, then (optionally) the
+    engine's digest fold over [tuple..., payload..., words...]."""
+    from madsim_tpu.engine.core import digest_fold
+    from madsim_tpu.ops.step_rng import step_words_v3
+
+    idx, any_v, popped = pop_gather_batch(*arrs, use_pallas=False)
+
+    class _Lay:  # step_words_v3 only reads these two fields
+        total_words = w
+        restart_off = None
+        version = 3
+
+    def words_of(key, step):
+        _, words, _ = step_words_v3(key, step, _Lay)
+        return words
+
+    words = jax.vmap(words_of)(keys, steps)
+    if d0 is None:
+        return idx, any_v, popped, words, ()
+    ev_time, ev_kind, ev_node, ev_src, ev_payload = popped
+
+    def fold(dd0, dd1, t, k, n, s, pay, ws):
+        return digest_fold(
+            dd0, dd1,
+            [t, k, n, s] + [pay[i] for i in range(pay.shape[0])]
+            + [ws[i] for i in range(w)],
+        )
+
+    nd0, nd1 = jax.vmap(fold)(
+        d0, d1, ev_time, ev_kind, ev_node, ev_src, ev_payload, words
+    )
+    return idx, any_v, popped, words, (nd0, nd1)
+
+
+@pytest.mark.parametrize("q", [32, 64])
+@pytest.mark.parametrize("p", [4, 6])
+def test_step_megakernel_matches_xla(q, p):
+    """Megakernel (interpreter mode) vs the XLA oracle: pop + gather +
+    the v3 word block + the digest fold, bit-for-bit, over the queue
+    capacities and payload widths the shipped models use — including an
+    ODD block width (the threefry pad/split edge)."""
+    from madsim_tpu.engine.core import digest_fold
+
+    w = 21 if p == 4 else 22  # odd and even block widths both covered
+    for seed in range(2):
+        arrs = _random_event_queues(jax.random.PRNGKey(seed), 24, q, p)
+        kk = jax.random.split(jax.random.PRNGKey(100 + seed), 24)
+        keys = jnp.asarray(kk, jnp.uint32)
+        steps = jax.random.randint(
+            jax.random.PRNGKey(200 + seed), (24,), 0, 5000, dtype=jnp.int32
+        )
+        d0 = jax.random.bits(jax.random.PRNGKey(300 + seed), (24,), jnp.uint32)
+        d1 = jax.random.bits(jax.random.PRNGKey(400 + seed), (24,), jnp.uint32)
+        xi, xa, xpop, xw, (xd0, xd1) = _oracle_step_prefix(
+            arrs, keys, steps, w, d0, d1
+        )
+        pi, pa, ppop, pw, (pd0, pd1) = step_megakernel(
+            *arrs, keys, steps, w, d0=d0, d1=d1, digest_fold=digest_fold,
+            interpret=True,
+        )
+        assert xa.tolist() == pa.tolist()
+        assert xi.tolist() == pi.tolist()
+        for xv, pv in zip(xpop, ppop):
+            assert xv.tolist() == pv.tolist()
+        assert xw.tolist() == pw.tolist()
+        assert xd0.tolist() == pd0.tolist() and xd1.tolist() == pd1.tolist()
+
+
+def test_step_megakernel_without_digest_and_unaligned():
+    """Recorder-off variant (no digest operands/outputs at all) over an
+    unaligned lane count: outputs sliced back, words still bit-exact."""
+    arrs = _random_event_queues(jax.random.PRNGKey(9), 13, 32, 4)
+    keys = jnp.asarray(jax.random.split(jax.random.PRNGKey(5), 13), jnp.uint32)
+    steps = jnp.arange(13, dtype=jnp.int32) * 7
+    xi, xa, xpop, xw, xdig = _oracle_step_prefix(arrs, keys, steps, 10)
+    pi, pa, ppop, pw, pdig = step_megakernel(
+        *arrs, keys, steps, 10, interpret=True
+    )
+    assert xdig == () and pdig == ()
+    assert pi.shape == (13,) and pw.shape == (13, 10)
+    assert xa.tolist() == pa.tolist() and xi.tolist() == pi.tolist()
+    for xv, pv in zip(xpop, ppop):
+        assert xv.tolist() == pv.tolist()
+    assert xw.tolist() == pw.tolist()

@@ -418,3 +418,104 @@ def test_perf_timeline_written_on_failure(tmp_path):
                 raise RuntimeError("boom")
     doc = json.loads((tmp_path / "fail.json").read_text())
     assert any(e.get("name") == "doomed" for e in doc["traceEvents"])
+
+
+# -- r11: warm-start compiles + the widened A/B default ----------------------
+
+
+def test_bench_ab_pairs_default_pinned():
+    """The bench-side interleaved pair count is a measurement-protocol
+    constant: 5 pairs (10 alternating reps) is the floor at which the
+    bootstrap CI of a sub-percent gate stops being the degenerate
+    [min, max] of two deltas (r10's coverage line straddled zero at 2
+    pairs). Changing it changes what every step_cost CI means — it must
+    look like a protocol change, not an env drift."""
+    from madsim_tpu.perf.ab import DEFAULT_BENCH_AB_PAIRS
+
+    assert DEFAULT_BENCH_AB_PAIRS == 5
+    # bench.py must bind the constant, not carry its own copy
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert "DEFAULT_BENCH_AB_PAIRS" in src
+    assert "MADSIM_TPU_BENCH_AB_PAIRS" in src  # env override retained
+
+
+def test_history_record_carries_warm_compile_and_cache_state(tmp_path):
+    """make_record / env_fingerprint round-trip the r11 fields: the
+    warm compile number and the cache state — and the cache state must
+    NOT break neighbor comparability (it never changes steady rate)."""
+    fp_cold = history.env_fingerprint(
+        backend_platform="cpu", lanes=64, reps=1, segment_steps=384,
+        gates={"rng_stream": 3}, compile_cache=False,
+    )
+    fp_warm = history.env_fingerprint(
+        backend_platform="cpu", lanes=64, reps=1, segment_steps=384,
+        gates={"rng_stream": 3}, compile_cache=True,
+    )
+    assert fp_cold["compile_cache"] is False and fp_warm["compile_cache"] is True
+    assert history.comparable(fp_cold, fp_warm)
+    rec = history.make_record(
+        "r99", 123.4, fp_warm, compile_s=22.5, compile_s_warm=3.1,
+    )
+    p = str(tmp_path / "h.jsonl")
+    history.append(p, rec)
+    [row] = history.load(p)
+    assert row["compile_s"] == 22.5 and row["compile_s_warm"] == 3.1
+    assert row["fingerprint"]["compile_cache"] is True
+
+
+def test_compile_cache_subkey_shape():
+    """cache_subkey renders the warm-start tuple — (jax version, gate
+    tuple, stream version, shape) — as one directory-name-safe string,
+    deterministically."""
+    from madsim_tpu.compile_cache import cache_subkey
+
+    k = cache_subkey(
+        gates={"coverage": True, "flight_recorder": False},
+        rng_stream=3, lanes=8192, segment_steps=384,
+    )
+    assert k == cache_subkey(
+        gates={"flight_recorder": False, "coverage": True},  # order-free
+        rng_stream=3, lanes=8192, segment_steps=384,
+    )
+    assert "rng3" in k and "l8192x384" in k
+    import re
+
+    assert re.fullmatch(r"[A-Za-z0-9._-]+", k), k
+    # jax/jaxlib versions discriminate upgrades
+    import jax
+
+    assert jax.__version__.replace("+", "_") in k or jax.__version__ in k
+
+
+def test_compile_cache_unwritable_fails_loud(tmp_path, monkeypatch):
+    """enable_compile_cache on an uncreatable directory: strict raises,
+    the default warns and leaves the cache OFF — never the old silent
+    degrade (a fleet that believes it is warm while every worker
+    recompiles). Probing is by actual write, not os.access (CI and the
+    reference box run as root, where access() lies)."""
+    from madsim_tpu import compile_cache as cc
+
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where a directory must go")
+    bad = str(blocker / "cache")
+    monkeypatch.setattr(cc, "_active_dir", None)
+    monkeypatch.delenv("MADSIM_TPU_COMPILE_CACHE", raising=False)
+    with pytest.raises(RuntimeError, match="not writable"):
+        cc.enable_compile_cache(bad, strict=True)
+    # non-strict: warns, returns None, cache stays off
+    assert cc.enable_compile_cache(bad) is None
+    assert cc._active_dir is None
+    # no path configured at all: no-op either way
+    assert cc.enable_compile_cache(None) is None
+
+
+def test_bench_reports_cold_and_warm_compile_keys():
+    """bench.py's JSON contract for the warm-start split: both keys
+    emitted, legacy "compile_s" preserved as the cold number (source
+    pin — running the flagship bench in tier-1 is out of budget; the CI
+    bench step asserts the live values)."""
+    src = open(os.path.join(REPO, "bench.py")).read()
+    for key in ('"compile_s_cold"', '"compile_s_warm"', '"compile_s"'):
+        assert key in src, key
+    assert "measure_warm_compile" in src
+    assert "enable_compile_cache(" in src and "strict=True" in src

@@ -219,6 +219,9 @@ def test_corpus_roundtrip_records_gates():
     d = corpus.config_to_dict(cfg)
     assert d["rng_stream"] == 3 and d["clog_packed"] is False
     assert "compile_cache_dir" not in d  # host-side knob, never recorded
+    # the megakernel is the same class: a perf knob the recording box
+    # resolved, asserted bit-identical — entries must replay anywhere
+    assert "pallas_megakernel" not in d
     back = corpus.config_from_dict(d)
     assert back.rng_stream == 3 and back.clog_packed is False
     # entries predating the gates decode to the legacy stream
@@ -348,3 +351,110 @@ def test_compile_cache_wiring(tmp_path, monkeypatch):
     assert os.path.isdir(active)
     if active == os.path.abspath(target):  # first enabler in this process
         assert os.listdir(active), "no cache entries written"
+
+
+@pytest.mark.skipif(not HAVE_PALLAS, reason="pallas unavailable")
+def test_megakernel_gate_bit_identical():
+    """The whole-event step megakernel (pop + gather + v3 RNG block +
+    digest fold in one fused pass, interpreter mode off-TPU) vs the XLA
+    oracle, end to end with the FULL 11-kind chaos palette plus
+    recorder + coverage + provenance riding the step — every result
+    leaf, every digest, every metric bit-identical. One engine pair
+    (tier-1 compile budget); the per-kernel Q/P grid lives in
+    tests/test_pallas.py."""
+    cfg = dataclasses.replace(
+        FULL_CHAOS,
+        rng_stream=3,
+        queue_capacity=96,
+        flight_recorder=True,
+        fr_digest_every=32,
+        fr_digest_ring=4,
+        coverage=True,
+        cov_slots_log2=12,
+        provenance=True,
+        faults=dataclasses.replace(
+            FULL_CHAOS.faults,
+            allow_pause=True,
+            allow_skew=True,
+            allow_dup=True,
+            allow_torn=True,
+            allow_heal_asym=True,
+            strict_restart=True,
+        ),
+    )
+    eng_mk = Engine(_machine(), dataclasses.replace(cfg, pallas_megakernel=True))
+    assert eng_mk.use_megakernel
+    r_mk = _run(eng_mk, n=16, max_steps=300)
+    eng_x = Engine(_machine(), dataclasses.replace(cfg, pallas_megakernel=False))
+    assert not eng_x.use_megakernel
+    r_x = _run(eng_x, n=16, max_steps=300)
+    _assert_results_equal(r_mk, r_x)
+    assert bool((r_mk.fail_prov == r_x.fail_prov).all())
+    for k in r_x.fr:
+        assert bool((r_mk.fr[k] == r_x.fr[k]).all()), k
+    assert bool((r_mk.cov["map"] == r_x.cov["map"]).all())
+
+
+def test_megakernel_requires_v3_stream():
+    """Explicitly requesting the megakernel on a v2 engine is a config
+    error (the kernel computes the counter-based block; v2's split
+    chain cannot be); auto/env resolution instead degrades to OFF so
+    legacy replays and shrink candidates keep working."""
+    with pytest.raises(ValueError, match="pallas_megakernel"):
+        Engine(
+            _machine(),
+            dataclasses.replace(BENCH_LIKE, rng_stream=2, pallas_megakernel=True),
+        )
+    eng = Engine(_machine(), dataclasses.replace(BENCH_LIKE, rng_stream=2))
+    assert not eng.use_megakernel
+
+
+def test_gate_off_segment_is_specialized():
+    """The observability bargain, pinned at the HLO level: with every
+    observability gate OFF the lowered streaming segment contains no
+    digest arithmetic (the fold multipliers), no coverage popcount and
+    no recorder/coverage/provenance operands — the gates compile to
+    NOTHING, not to dead data movement. With the gates ON the same
+    probes must appear (so the string-match is proven meaningful)."""
+    import jax
+
+    def lowered_segment_text(cfg):
+        eng = Engine(_machine(), cfg)
+        init_carry, segment, _, _ = eng._stream_fns(128, 2000, 64, 32)
+        seeds = jnp.arange(32, dtype=jnp.uint32)
+        carry_shape = jax.eval_shape(init_carry, seeds)
+        return eng, segment.lower(carry_shape).as_text()
+
+    off_cfg = dataclasses.replace(FULL_CHAOS, rng_stream=3)
+    eng_off, off_txt = lowered_segment_text(off_cfg)
+    # digest fold multipliers (core._DIGEST_M0/M1) — M1 doubles as the
+    # coverage mix multiplier, so its absence also proves no slot hash;
+    # the coverage mix SEED (0x9E3779B9) is the third probe. (popcnt is
+    # deliberately not probed: the raft model's own vote-bitmask tally
+    # legitimately popcounts inside the handler.)
+    assert "2654435761" not in off_txt  # 0x9E3779B1 digest M0
+    assert "2245273453" not in off_txt  # 0x85EBCA6B digest M1 / cov mix mult
+    assert "2654435769" not in off_txt  # 0x9E3779B9 cov mix seed
+    # dead operands pruned from the carry, not threaded as zeros
+    carry = jax.eval_shape(
+        eng_off._stream_fns(128, 2000, 64, 32)[0],
+        jnp.arange(32, dtype=jnp.uint32),
+    )
+    assert carry.fr_metrics.shape == (0,)
+    assert carry.cov_map.shape == (0,)
+    assert carry.fail_provs.shape == (0,)
+    assert carry.state.eq_prov.shape == (32, 0)
+    assert carry.state.fr == {} and carry.state.cov == {}
+
+    on_cfg = dataclasses.replace(
+        FULL_CHAOS, rng_stream=3, flight_recorder=True, fr_digest_every=32,
+        fr_digest_ring=4, coverage=True, cov_slots_log2=12, provenance=True,
+    )
+    eng_on, on_txt = lowered_segment_text(on_cfg)
+    assert "2654435761" in on_txt and "2654435769" in on_txt
+    carry_on = jax.eval_shape(
+        eng_on._stream_fns(128, 2000, 64, 32)[0],
+        jnp.arange(32, dtype=jnp.uint32),
+    )
+    assert carry_on.fr_metrics.shape != (0,)
+    assert carry_on.state.eq_prov.shape == (32, on_cfg.queue_capacity)
