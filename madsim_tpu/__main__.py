@@ -310,13 +310,15 @@ def _print_fr_stats(stats) -> None:
 def _make_emitter(args):
     """StatsEmitter bound to --stats BASE (also $MADSIM_TPU_STATS):
     BASE.jsonl (history), BASE.prom (Prometheus textfile), BASE.json
-    (latest snapshot — what `serve --service stats` exposes)."""
+    (latest snapshot — what `serve --service stats` exposes).
+    `args.stats_labels` (set by the fleet worker, not a CLI flag)
+    namespaces the Prometheus gauges per job."""
     base = getattr(args, "stats", None) or os.environ.get("MADSIM_TPU_STATS")
     if not base:
         return None
     from .tracing import StatsEmitter
 
-    return StatsEmitter(base)
+    return StatsEmitter(base, labels=getattr(args, "stats_labels", None))
 
 
 def _print_cov_stats(stats) -> None:
@@ -1220,20 +1222,21 @@ def _serve_stats(args) -> int:
         def log_message(self, fmt, *a):  # route access logs to logging
             logging.getLogger("madsim_tpu.serve").debug(fmt, *a)
 
-    host, port = args.addr.rsplit(":", 1)
-    srv = http.server.ThreadingHTTPServer((host, int(port)), Handler)
+    # shared daemon glue (fleet/httpd.py): --port-file writes the
+    # realized port atomically so tests/workers discover a host:0 bind
+    # without racing, and SIGTERM now closes the server as gracefully
+    # as Ctrl-C always did
+    from .fleet import httpd
+
+    srv, host, port = httpd.bind(args.addr, Handler)
     print(
-        f"stats serving on {host}:{srv.server_address[1]} "
+        f"stats serving on {host}:{port} "
         f"(GET /stats /metrics /healthz; files {base}.json/.prom)",
         flush=True,
     )
-    try:
-        srv.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    finally:
-        srv.server_close()
-    return 0
+    return httpd.run_http_server(
+        srv, port_file=getattr(args, "port_file", None)
+    )
 
 
 def cmd_lint(args) -> int:
@@ -1340,6 +1343,63 @@ def cmd_serve(args) -> int:
     except KeyboardInterrupt:
         pass
     return 0
+
+
+def cmd_fleet(args) -> int:
+    """The hunt-farm service (madsim_tpu/fleet): a durable job store +
+    queue, a lease-based worker that slices jobs into checkpointed
+    batch units, and a jax-free HTTP control plane + client verbs.
+    Only `fleet worker` touches jax; serve/submit/status/result/cancel/
+    queue run on boxes with no accelerator stack."""
+    sub = args.fleet_cmd
+    if sub == "serve":
+        from .fleet import api
+
+        return api.serve(args.root, args.addr, port_file=args.port_file)
+    if sub == "worker":
+        from .fleet.worker import FleetWorker
+
+        worker = FleetWorker(
+            args.root,
+            worker_id=args.worker_id or f"w{os.getpid()}",
+            lease_ttl_s=args.lease_ttl,
+            poll_s=args.poll,
+        )
+        return worker.run(drain=args.drain, max_units=args.max_units)
+    from .fleet import client
+
+    try:
+        addr = client.resolve_addr(args.addr, getattr(args, "port_file", None))
+        if sub == "submit":
+            from .fleet.store import SPEC_FIELDS
+
+            spec = {k: getattr(args, k) for k in SPEC_FIELDS}
+            out = client.submit(
+                addr, spec, priority=args.priority, deadline_s=args.deadline
+            )
+            # stdout is exactly the job id — script-composable
+            # (`JOB=$(python -m madsim_tpu fleet submit ...)`)
+            print(out["id"])
+            return 0
+        if sub == "status":
+            print(json.dumps(client.status(addr, args.job, feed=args.feed),
+                             indent=1, sort_keys=True))
+            return 0
+        if sub == "result":
+            doc = client.result(addr, args.job)
+            print(json.dumps(doc, indent=1, sort_keys=True))
+            return 0 if doc.get("state") != "failed" else 1
+        if sub == "cancel":
+            print(json.dumps(client.cancel(addr, args.job),
+                             indent=1, sort_keys=True))
+            return 0
+        if sub == "queue":
+            print(json.dumps(client.queue(addr), indent=1, sort_keys=True))
+            return 0
+        raise AssertionError(f"unhandled fleet verb {sub!r}")
+    except (client.FleetClientError, RuntimeError, OSError) as exc:
+        print(f"fleet {sub}: {exc}", file=sys.stderr)
+        return 1
 
 
 def cmd_perf(args) -> int:
@@ -1903,6 +1963,12 @@ def main(argv=None) -> int:
         "(default $MADSIM_TPU_STATS or ./madsim_stats)",
     )
     p.add_argument(
+        "--port-file", default=None, metavar="PATH",
+        help="stats service only: atomically write the realized port to "
+        "PATH after binding (with --addr host:0, tests and fleet "
+        "workers discover the daemon without racing its stdout)",
+    )
+    p.add_argument(
         "--grpc",
         action="store_true",
         help="etcd only: serve the genuine etcd v3 gRPC wire protocol "
@@ -1929,6 +1995,135 @@ def main(argv=None) -> int:
         "127.0.0.1 when binding 0.0.0.0)",
     )
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "fleet",
+        help="the hunt farm: DST as a continuously operating service — "
+        "a durable job store + queue (JSON-on-disk, atomic, "
+        "fingerprinted), `worker` (leases jobs, runs checkpointed "
+        "batch units packed by warm-compile subkey, shrinks + files "
+        "finds), `serve` (jax-free HTTP control plane: POST /jobs, "
+        "GET /jobs/{id}[/result], DELETE /jobs/{id}, /queue /metrics "
+        "/healthz) and thin client verbs",
+    )
+    fl = p.add_subparsers(dest="fleet_cmd", required=True)
+
+    def fleet_root(q):
+        q.add_argument(
+            "--root", default=os.environ.get("MADSIM_TPU_FLEET_ROOT", "fleet"),
+            help="fleet state directory (jobs/, corpus.json; also "
+            "$MADSIM_TPU_FLEET_ROOT)",
+        )
+
+    def fleet_client_flags(q):
+        q.add_argument(
+            "--addr", default=None,
+            help="control-plane host:port (default $MADSIM_TPU_FLEET_ADDR "
+            "or 127.0.0.1:8142)",
+        )
+        q.add_argument(
+            "--port-file", default=None, metavar="PATH",
+            help="resolve the daemon as 127.0.0.1:<port read from PATH> "
+            "(the file `fleet serve --port-file` writes atomically)",
+        )
+
+    q = fl.add_parser("serve", help="jax-free HTTP control plane over a fleet root")
+    obs_flags(q)
+    fleet_root(q)
+    q.add_argument("--addr", default="127.0.0.1:8142",
+                   help="bind host:port (port 0 = ephemeral)")
+    q.add_argument(
+        "--port-file", default=None, metavar="PATH",
+        help="atomically write the realized port to PATH after binding",
+    )
+    q.set_defaults(fn=cmd_fleet)
+
+    q = fl.add_parser(
+        "worker",
+        help="lease jobs and run them one checkpointed batch unit at a "
+        "time (kill -9 loses at most one batch; jobs sharing a "
+        "cache_subkey run back-to-back on the warm jit)",
+    )
+    obs_flags(q)
+    fleet_root(q)
+    q.add_argument("--worker-id", default=None,
+                   help="stable lease identity (default w<pid>; reusing an "
+                   "id reclaims its own leases immediately after a crash)")
+    q.add_argument("--lease-ttl", type=float, default=60.0,
+                   help="seconds before a dead worker's jobs become "
+                   "reclaimable")
+    q.add_argument("--poll", type=float, default=0.5,
+                   help="idle store-poll interval in seconds")
+    q.add_argument("--drain", action="store_true",
+                   help="exit once every job is terminal (CI/batch mode) "
+                   "instead of serving forever")
+    q.add_argument("--max-units", type=int, default=0,
+                   help="exit after N work units (deterministic "
+                   "interruption for tests; 0 = unlimited)")
+    q.add_argument(
+        "--compile-cache", default=os.environ.get("MADSIM_TPU_COMPILE_CACHE"),
+        help="JAX persistent compilation cache directory (also "
+        "$MADSIM_TPU_COMPILE_CACHE) — a warm cache makes a fresh "
+        "worker productive in seconds",
+    )
+    q.add_argument(
+        "--perf-timeline", default=None, metavar="PATH",
+        help="record the worker's host timeline (per-unit fleet_unit "
+        "spans with job ids wrapping the usual compile/dispatch/poll "
+        "spans) as Perfetto trace_event JSON",
+    )
+    q.set_defaults(fn=cmd_fleet)
+
+    q = fl.add_parser("submit", help="submit a hunt job; prints the job id")
+    obs_flags(q)
+    fleet_client_flags(q)
+    q.add_argument("--machine", required=True)
+    q.add_argument("--nodes", type=int, default=0)
+    q.add_argument("--seed", type=int, default=0, help="seed-range start")
+    q.add_argument("--seeds", type=int, default=1024, help="seed budget")
+    q.add_argument("--batch", type=int, default=256,
+                   help="lanes per batch unit (the checkpoint granularity)")
+    q.add_argument("--horizon", type=float, default=5.0)
+    q.add_argument("--max-steps", type=int, default=3000)
+    q.add_argument("--queue", type=int, default=96)
+    q.add_argument("--faults", type=int, default=2)
+    q.add_argument("--loss", type=float, default=0.0)
+    q.add_argument("--fault-tmax", type=int, default=0)
+    q.add_argument("--fault-kinds", default="pair,kill")
+    q.add_argument("--rng-stream", type=int, default=2, choices=(2, 3))
+    q.add_argument("--strict-restart", action="store_true")
+    q.add_argument("--coverage", action="store_true")
+    q.add_argument("--provenance", action="store_true")
+    q.add_argument("--flight-recorder", action="store_true")
+    q.add_argument("--stop-on-plateau", type=int, default=0)
+    q.add_argument("--shrink-limit", type=int, default=5,
+                   help="max distinct-code finds to shrink + file")
+    q.add_argument("--priority", type=int, default=0,
+                   help="higher runs earlier (and may pay a compile switch)")
+    q.add_argument("--deadline", type=float, default=None,
+                   help="relative deadline in wall seconds; the worker "
+                   "stops the job when it passes")
+    q.set_defaults(fn=cmd_fleet)
+
+    for verb, hlp in (
+        ("status", "job document + live per-batch feed"),
+        ("result", "find + shrunk repro + why attribution (terminal jobs)"),
+        ("cancel", "cancel a job (queued dies now; running at the next "
+                   "unit boundary)"),
+    ):
+        q = fl.add_parser(verb, help=hlp)
+        obs_flags(q)
+        fleet_client_flags(q)
+        q.add_argument("job", help="job id (from `fleet submit`)")
+        if verb == "status":
+            q.add_argument("--feed", type=int, default=20,
+                           help="live-feed rows to include")
+        q.set_defaults(fn=cmd_fleet)
+
+    q = fl.add_parser("queue", help="state counts + per-job summaries")
+    obs_flags(q)
+    fleet_client_flags(q)
+    q.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser(
         "lint",
@@ -1959,6 +2154,10 @@ def main(argv=None) -> int:
     jax_free = args.cmd in ("serve", "coverage", "lint") or (
         # `bench report` renders history with no jax import at all
         args.cmd == "bench" and getattr(args, "action", None) == "report"
+    ) or (
+        # the whole fleet control plane (serve + client verbs) is
+        # jax-free by contract; only the worker runs engines
+        args.cmd == "fleet" and args.fleet_cmd != "worker"
     )
     if getattr(args, "multihost", False):
         # distributed init must precede ANY backend access — including

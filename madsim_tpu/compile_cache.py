@@ -49,6 +49,7 @@ def cache_subkey(
     rng_stream: Optional[int] = None,
     lanes: Optional[int] = None,
     segment_steps: Optional[int] = None,
+    import_jax: bool = True,
 ) -> str:
     """A directory-name-safe warm-start key: (jax/jaxlib version, gate
     tuple, stream version, shape key). Two processes with equal subkeys
@@ -61,14 +62,24 @@ def cache_subkey(
     True, ...}); bool values render as 0/1, the rest as-is. Unknown /
     None fields are simply omitted — the key is best-effort
     discrimination, jax's internal (HLO, jaxlib, flags, device) key is
-    what guarantees correctness."""
-    try:
-        import jax
-        import jaxlib
+    what guarantees correctness.
 
-        parts = [f"jax{jax.__version__}-jaxlib{jaxlib.__version__}"]
-    except Exception:  # pragma: no cover - jax-free callers
+    `import_jax=False` pins the version prefix to `jax-unknown`
+    WITHOUT touching jax (even when it is importable): the fleet
+    control plane computes job-grouping subkeys jax-free, and a
+    grouping key must be identical no matter which process renders it
+    — the allocator needs EQUALITY, not version discrimination (jax's
+    internal cache key still provides that for the actual entries)."""
+    if not import_jax:
         parts = ["jax-unknown"]
+    else:
+        try:
+            import jax
+            import jaxlib
+
+            parts = [f"jax{jax.__version__}-jaxlib{jaxlib.__version__}"]
+        except Exception:  # pragma: no cover - jax-free callers
+            parts = ["jax-unknown"]
     if rng_stream is not None:
         parts.append(f"rng{rng_stream}")
     if gates:

@@ -262,6 +262,10 @@ def record_entry(
         digest_every=every,
         digests=digests,
         digest_final=final,
-        meta=engine_meta(entry.config),
+        # MERGE with the caller's meta rather than replacing it: the
+        # fleet files entries with provenance keys (`filed_by`,
+        # `repro`, `why_kinds`) that must survive re-recording; the
+        # environment fingerprint wins on any key collision.
+        meta={**entry.meta, **engine_meta(entry.config)},
     )
     return new, trail

@@ -80,6 +80,13 @@ class CorpusEntry:
     digest_every: int = 0
     digests: list = dataclasses.field(default_factory=list)
     digest_final: list = dataclasses.field(default_factory=list)
+    # Free-form provenance. `audit.record_entry` merges the environment
+    # fingerprint (jax/jaxlib/python/engine versions) in here; entries
+    # filed by the hunt fleet additionally carry `filed_by` ({job,
+    # worker, fingerprint_sha} — which fleet job found this), `repro`
+    # (the minimal replay command line) and `why_kinds` (the causally
+    # implicated fault kinds from the provenance word). Keys survive
+    # re-recording: the auditor merges rather than replaces.
     meta: dict = dataclasses.field(default_factory=dict)
 
     @property

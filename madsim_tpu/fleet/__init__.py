@@ -1,0 +1,49 @@
+"""The hunt fleet — DST as a continuously operating farm.
+
+The paper's batch-entry convention (`MADSIM_TEST_SEED`/`MADSIM_TEST_NUM`
+driving thousands of seeds per invocation) is a CLI-shaped API for
+exactly one user. This package is the *service* shape the ROADMAP north
+star asks for: CI fleets and many users submitting concurrent hunts
+against a long-lived, warm-compiled engine. Everything here is
+composition of library pieces that already exist — fingerprinted
+`hunt --checkpoint` resume, StatsEmitter JSONL/Prometheus, the plateau
+detector, `cache_subkey`-routed warm compiles, PerfRecorder timelines,
+`shrink` + `why` attribution — plus the three things that make them a
+daemon:
+
+* `store` — a durable job store + queue: JSON-on-disk with atomic
+  writes (the `runtime/checkpoint.py` discipline), a full lifecycle
+  state machine (queued -> compiling -> running -> plateaued/exhausted/
+  found -> shrunk -> filed, plus cancelled/failed), worker leases with
+  expiry, and an argument fingerprint so a resumed worker refuses
+  drifted job definitions exactly like checkpoints do.
+* `allocator` — the multi-tenant lane allocator: one work unit = one
+  seed batch of one job; jobs sharing an engine `cache_subkey` are
+  packed back-to-back so they reuse the warm jit (never two engine
+  configs in flight at once on a 1-core box), with priority/deadline
+  deciding which subkey group runs.
+* `worker` — `python -m madsim_tpu fleet worker`: leases jobs, runs
+  them one batch-sized unit at a time through the existing checkpoint
+  machinery (a `kill -9` mid-job loses at most one batch), honors
+  plateau/deadline/cancel stops, and on a find runs `shrink` +
+  provenance attribution and files the result as a corpus entry with
+  its minimal repro line and filed-by-job metadata.
+* `api` + `client` — the jax-free control plane: `fleet serve` (stdlib
+  `http.server`, extending the `serve --service stats` pattern) with
+  POST /jobs, GET /jobs/{id} (live per-batch feed), GET
+  /jobs/{id}/result, DELETE /jobs/{id}, GET /queue, /metrics,
+  /healthz; `fleet submit|status|result|cancel|queue` wrap it.
+
+The determinism contract makes the farm auditable: any job's find
+replays from its recorded repro line alone (`regress` on the fleet
+corpus), and a whole job re-run is fully described by
+(fingerprint, seed schedule) — both recorded in the store.
+"""
+
+from .store import (  # noqa: F401
+    Job,
+    JobStore,
+    STATES,
+    TERMINAL,
+    spec_to_args,
+)

@@ -1,0 +1,242 @@
+"""The fleet control plane — a jax-free stdlib HTTP API over the store.
+
+Extends the `serve --service stats` pattern (plain `http.server`,
+read-only files, no sim/jax imports) to a read/write job API::
+
+    POST   /jobs             submit {"spec": {...}, "priority", "deadline_s"}
+                             (a bare spec object also works)
+    GET    /jobs             = /queue
+    GET    /queue            state counts + per-job summaries
+    GET    /jobs/{id}        full job doc + live feed (?feed=N batch rows
+                             from the job's StatsEmitter JSONL)
+    GET    /jobs/{id}/result find + shrunk repro + `why` attribution
+                             (409 until the job reaches a terminal state)
+    DELETE /jobs/{id}        cancel (queued dies now; running at the next
+                             unit boundary)
+    GET    /metrics          Prometheus: fleet gauges + every job's own
+                             StatsEmitter textfile, label-namespaced
+    GET    /healthz          liveness
+
+Everything the API serves is an atomic-rename artifact (job docs,
+StatsEmitter snapshots), so no response can observe a torn write — and
+because the store is the wire, the API keeps answering while a worker
+is mid-dispatch (they share only the filesystem).
+
+`FleetAPI.handle()` is the whole router, separated from the socket so
+handler tests run against a store in a tmpdir with zero networking.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import os
+import re
+from typing import Optional, Tuple
+
+from . import httpd
+from .store import JobStore, STATES, TERMINAL
+
+_LOG = logging.getLogger("madsim_tpu.fleet.api")
+
+_JOB_RE = re.compile(r"^/jobs/([A-Za-z0-9._-]+)(/result)?$")
+
+
+def _json(status: int, doc) -> Tuple[int, str, bytes]:
+    body = (json.dumps(doc, indent=1, sort_keys=True) + "\n").encode()
+    return status, "application/json", body
+
+
+def _err(status: int, msg: str) -> Tuple[int, str, bytes]:
+    return _json(status, {"error": msg})
+
+
+def _job_summary(job) -> dict:
+    return {
+        "id": job.id,
+        "state": job.state,
+        "machine": job.spec["machine"],
+        "seeds": job.spec["seeds"],
+        "priority": job.priority,
+        "subkey": job.subkey,
+        "cancel_requested": job.cancel_requested,
+        "batches_run": job.progress.get("batches_run", 0),
+        "batches_planned": job.progress.get("batches_planned"),
+        "failing": job.progress.get("failing", 0),
+    }
+
+
+class FleetAPI:
+    def __init__(self, store: JobStore):
+        self.store = store
+
+    # -- router --------------------------------------------------------------
+
+    def handle(self, method: str, path: str,
+               body: Optional[bytes] = None) -> Tuple[int, str, bytes]:
+        path, _, query = path.partition("?")
+        path = path.rstrip("/") or "/"
+        try:
+            if path == "/healthz" and method == "GET":
+                return 200, "text/plain", b"ok\n"
+            if path == "/metrics" and method == "GET":
+                return 200, "text/plain; version=0.0.4", self._metrics()
+            if path in ("/queue", "/jobs") and method == "GET":
+                return self._queue()
+            if path == "/jobs" and method == "POST":
+                return self._submit(body)
+            m = _JOB_RE.match(path)
+            if m:
+                job_id, result = m.group(1), bool(m.group(2))
+                if result and method == "GET":
+                    return self._result(job_id)
+                if not result and method == "GET":
+                    return self._status(job_id, query)
+                if not result and method == "DELETE":
+                    return self._cancel(job_id)
+            return _err(
+                404,
+                "routes: GET /queue /jobs/{id} /jobs/{id}/result /metrics "
+                "/healthz; POST /jobs; DELETE /jobs/{id}",
+            )
+        except KeyError as exc:
+            return _err(404, str(exc.args[0]) if exc.args else "not found")
+        except ValueError as exc:
+            return _err(400, str(exc))
+
+    # -- endpoints -----------------------------------------------------------
+
+    def _submit(self, body: Optional[bytes]) -> Tuple[int, str, bytes]:
+        try:
+            doc = json.loads((body or b"").decode() or "{}")
+        except json.JSONDecodeError as exc:
+            return _err(400, f"body is not JSON: {exc}")
+        if not isinstance(doc, dict):
+            return _err(400, "body must be a JSON object")
+        spec = doc.get("spec", None)
+        if spec is None:
+            # bare-spec convenience: {"machine": ...} without the wrapper
+            spec = {k: v for k, v in doc.items()
+                    if k not in ("priority", "deadline_s")}
+        job = self.store.submit(
+            spec,
+            priority=int(doc.get("priority", 0) or 0),
+            deadline_s=doc.get("deadline_s"),
+        )
+        return _json(201, {"id": job.id, "state": job.state,
+                           "subkey": job.subkey})
+
+    def _queue(self) -> Tuple[int, str, bytes]:
+        jobs = self.store.list()
+        return _json(200, {
+            "counts": {s: n for s, n in self.store.counts().items() if n},
+            "jobs": [_job_summary(j) for j in jobs],
+        })
+
+    def _status(self, job_id: str, query: str) -> Tuple[int, str, bytes]:
+        job = self.store.get(job_id)
+        feed_n = 20
+        m = re.search(r"(?:^|&)feed=(\d+)", query)
+        if m:
+            feed_n = min(int(m.group(1)), 1000)
+        doc = job.to_dict()
+        doc["feed"] = self.store.read_feed(job_id, last=feed_n)
+        return _json(200, doc)
+
+    def _result(self, job_id: str) -> Tuple[int, str, bytes]:
+        job = self.store.get(job_id)
+        if job.state not in TERMINAL:
+            return _err(
+                409,
+                f"job {job_id} is {job.state}; results exist once the job "
+                f"reaches a terminal state ({', '.join(sorted(TERMINAL))})",
+            )
+        return _json(200, {
+            "id": job.id,
+            "state": job.state,
+            "error": job.error,
+            "result": job.result,
+        })
+
+    def _cancel(self, job_id: str) -> Tuple[int, str, bytes]:
+        job = self.store.request_cancel(job_id)
+        return _json(200, {
+            "id": job.id,
+            "state": job.state,
+            "cancel_requested": job.cancel_requested,
+        })
+
+    # -- metrics -------------------------------------------------------------
+
+    def _metrics(self) -> bytes:
+        """Fleet-level gauges plus every job's own StatsEmitter
+        Prometheus textfile. Per-job files are label-namespaced by the
+        worker (`{job="<id>"}`), so concatenation is a valid exposition
+        — `# TYPE` lines are deduped across files."""
+        lines = ["# madsim_tpu fleet control plane"]
+        counts = self.store.counts()
+        lines.append("# TYPE madsim_tpu_fleet_jobs gauge")
+        for s in STATES:
+            lines.append(f'madsim_tpu_fleet_jobs{{state="{s}"}} {counts.get(s, 0)}')
+        seen_types = {"madsim_tpu_fleet_jobs"}
+        for job in self.store.list():
+            prom = self.store.stats_base(job.id) + ".prom"
+            if not os.path.exists(prom):
+                continue
+            try:
+                with open(prom) as f:
+                    for line in f.read().splitlines():
+                        if line.startswith("# TYPE "):
+                            name = line.split()[2]
+                            if name in seen_types:
+                                continue
+                            seen_types.add(name)
+                        elif line.startswith("#"):
+                            continue
+                        lines.append(line)
+            except OSError:
+                continue
+        return ("\n".join(lines) + "\n").encode()
+
+
+def make_handler(api: FleetAPI):
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _dispatch(self, method: str) -> None:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else None
+            status, ctype, payload = api.handle(method, self.path, body)
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_GET(self):  # noqa: N802 (stdlib API name)
+            self._dispatch("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._dispatch("POST")
+
+        def do_DELETE(self):  # noqa: N802
+            self._dispatch("DELETE")
+
+        def log_message(self, fmt, *a):  # route access logs to logging
+            _LOG.debug(fmt, *a)
+
+    return Handler
+
+
+def serve(root: str, addr: str, port_file: Optional[str] = None) -> int:
+    """`fleet serve` entry: bind (port 0 supported), announce the
+    realized port (stdout + optional --port-file), serve until
+    SIGTERM/Ctrl-C, close gracefully."""
+    store = JobStore(root)
+    srv, host, port = httpd.bind(addr, make_handler(FleetAPI(store)))
+    print(
+        f"fleet control plane on {host}:{port} (root {store.root}; "
+        f"GET /queue /jobs/{{id}} /jobs/{{id}}/result /metrics /healthz, "
+        f"POST /jobs, DELETE /jobs/{{id}})",
+        flush=True,
+    )
+    return httpd.run_http_server(srv, port_file=port_file)

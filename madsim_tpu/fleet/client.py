@@ -1,0 +1,103 @@
+"""Thin stdlib HTTP client for the fleet control plane.
+
+`python -m madsim_tpu fleet submit|status|result|cancel|queue` wrap
+these calls; scripts can import them directly. Discovery mirrors the
+server side: `--addr host:port`, or `--port-file PATH` (the file
+`fleet serve --port-file` / `serve --port-file` writes atomically)
+resolves to `127.0.0.1:<port>` without racing the daemon's startup.
+
+Jax-free by construction — the client runs on boxes with no
+accelerator stack at all.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from typing import Optional, Tuple
+
+from . import httpd
+
+DEFAULT_ADDR = "127.0.0.1:8142"
+
+
+class FleetClientError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+def resolve_addr(addr: Optional[str] = None,
+                 port_file: Optional[str] = None,
+                 wait_s: float = 5.0) -> str:
+    """Pick the daemon address: explicit --addr wins, then --port-file
+    (polled up to `wait_s` — the file appears atomically once the
+    server has bound), then $MADSIM_TPU_FLEET_ADDR, then the default."""
+    if addr:
+        return addr
+    if port_file:
+        # madsim: allow(D001) — host-side startup-discovery poll
+        deadline = time.monotonic() + wait_s
+        while True:
+            try:
+                return f"127.0.0.1:{httpd.read_port_file(port_file)}"
+            except (OSError, ValueError):
+                if time.monotonic() > deadline:  # madsim: allow(D001)
+                    raise RuntimeError(
+                        f"port file {port_file!r} did not appear within "
+                        f"{wait_s}s — is the daemon running?"
+                    ) from None
+                time.sleep(0.05)  # madsim: allow(D001)
+    return os.environ.get("MADSIM_TPU_FLEET_ADDR", DEFAULT_ADDR)
+
+
+def request(addr: str, method: str, path: str,
+            body: Optional[dict] = None,
+            timeout: float = 30.0) -> Tuple[int, dict]:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f"http://{addr}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode() or "{}")
+    except urllib.error.HTTPError as exc:
+        payload = exc.read().decode(errors="replace")
+        try:
+            msg = json.loads(payload).get("error", payload)
+        except json.JSONDecodeError:
+            msg = payload
+        raise FleetClientError(exc.code, msg) from None
+
+
+def submit(addr: str, spec: dict, *, priority: int = 0,
+           deadline_s: Optional[float] = None) -> dict:
+    doc = {"spec": spec, "priority": priority}
+    if deadline_s:
+        doc["deadline_s"] = deadline_s
+    _, out = request(addr, "POST", "/jobs", doc)
+    return out
+
+
+def status(addr: str, job_id: str, feed: int = 20) -> dict:
+    _, out = request(addr, "GET", f"/jobs/{job_id}?feed={feed}")
+    return out
+
+
+def result(addr: str, job_id: str) -> dict:
+    _, out = request(addr, "GET", f"/jobs/{job_id}/result")
+    return out
+
+
+def cancel(addr: str, job_id: str) -> dict:
+    _, out = request(addr, "DELETE", f"/jobs/{job_id}")
+    return out
+
+
+def queue(addr: str) -> dict:
+    _, out = request(addr, "GET", "/queue")
+    return out

@@ -1,0 +1,503 @@
+"""Durable job store + queue — JSON-on-disk, atomic, fingerprinted.
+
+One job = one file under `<root>/jobs/<id>.json`, written with the
+`runtime/checkpoint.py` discipline (tmp + rename) so a kill mid-write
+leaves the previous document intact and the jax-free control plane
+never serves a torn read. The store IS the wire between the API server
+and the worker: POST /jobs writes a `queued` document, the worker polls
+the directory — no RPC, and both sides survive restarts for free.
+
+Lifecycle state machine::
+
+    queued -> compiling -> running -> plateaued | exhausted | found
+                                      found -> shrunk -> filed
+    (queued|compiling|running|found) -> cancelled
+    (compiling|running|found|shrunk) -> failed
+
+Every job records the same argument FINGERPRINT the checkpoint
+machinery uses (`runtime/checkpoint.fingerprint_from_args` over the
+spec), plus a sha256 of the normalized spec: a worker that leases a job
+whose spec no longer hashes to its recorded fingerprint refuses it —
+exactly like a `--checkpoint` resume refuses a drifted command line —
+instead of silently blending two different hunts.
+
+Pure host-side stdlib — no jax import anywhere in this module, so the
+`fleet serve` control plane stays jax-free.
+"""
+
+from __future__ import annotations
+
+# madsim: allow-file(D001) — submit/lease/history wall-clock stamps are
+# this host-side service's contract (lease expiry, deadlines, audit
+# trail); nothing here feeds simulation state. Virtual time lives in
+# the engine, and a job's *results* are a pure function of
+# (fingerprint, seed schedule), both recorded below.
+import contextlib
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import time
+from types import SimpleNamespace
+from typing import Callable, Dict, List, Optional
+
+from ..runtime.checkpoint import fingerprint_from_args
+
+try:  # POSIX file locks guard read-modify-write; no-op elsewhere
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX fallback
+    fcntl = None
+
+# -- lifecycle ---------------------------------------------------------------
+
+QUEUED = "queued"
+COMPILING = "compiling"
+RUNNING = "running"
+PLATEAUED = "plateaued"   # coverage plateau stop, no finds
+EXHAUSTED = "exhausted"   # seed budget (or deadline) consumed, no finds
+FOUND = "found"           # finds harvested, shrink pending
+SHRUNK = "shrunk"         # finds minimized, filing pending
+FILED = "filed"           # corpus entries + result written
+CANCELLED = "cancelled"
+FAILED = "failed"
+
+STATES = (QUEUED, COMPILING, RUNNING, PLATEAUED, EXHAUSTED, FOUND,
+          SHRUNK, FILED, CANCELLED, FAILED)
+TERMINAL = frozenset({PLATEAUED, EXHAUSTED, FILED, CANCELLED, FAILED})
+#: states a worker may hold a lease in (crash recovery re-leases these)
+LEASABLE = frozenset({QUEUED, COMPILING, RUNNING, FOUND, SHRUNK})
+
+_TRANSITIONS: Dict[str, frozenset] = {
+    # queued -> failed: a job can be refused before compiling (unknown
+    # machine, fingerprint drift detected at lease time)
+    QUEUED: frozenset({COMPILING, CANCELLED, FAILED}),
+    COMPILING: frozenset({RUNNING, FAILED, CANCELLED}),
+    RUNNING: frozenset({PLATEAUED, EXHAUSTED, FOUND, FAILED, CANCELLED}),
+    FOUND: frozenset({SHRUNK, FAILED, CANCELLED}),
+    SHRUNK: frozenset({FILED, FAILED}),
+    PLATEAUED: frozenset(),
+    EXHAUSTED: frozenset(),
+    FILED: frozenset(),
+    CANCELLED: frozenset(),
+    FAILED: frozenset(),
+}
+
+# -- job spec ----------------------------------------------------------------
+
+#: whitelisted spec fields -> (type, default). Mirrors the hunt CLI;
+#: `batch` defaults to the CI shape (256 lanes) where a warm worker
+#: compiles in ~4 s, not the flagship 8192.
+SPEC_FIELDS = {
+    "machine": (str, None),          # required
+    "nodes": (int, 0),
+    "seed": (int, 0),
+    "seeds": (int, 1024),
+    "batch": (int, 256),
+    "horizon": (float, 5.0),
+    "max_steps": (int, 3000),
+    "queue": (int, 96),
+    "faults": (int, 2),
+    "loss": (float, 0.0),
+    "fault_tmax": (int, 0),
+    "fault_kinds": (str, "pair,kill"),
+    "rng_stream": (int, 2),
+    "strict_restart": (bool, False),
+    "coverage": (bool, False),
+    "provenance": (bool, False),
+    "flight_recorder": (bool, False),
+    "stop_on_plateau": (int, 0),
+    "shrink_limit": (int, 5),
+}
+
+SEGMENT_STEPS = 384  # the streaming driver's pinned segment shape
+
+
+def normalize_spec(spec: dict) -> dict:
+    """Validate + default a job spec. Raises ValueError (the API maps it
+    to 400) on unknown fields, a missing machine, or type mismatches."""
+    unknown = sorted(set(spec) - set(SPEC_FIELDS))
+    if unknown:
+        raise ValueError(
+            f"unknown spec fields {unknown}; known: {sorted(SPEC_FIELDS)}"
+        )
+    out = {}
+    for name, (typ, default) in SPEC_FIELDS.items():
+        v = spec.get(name, default)
+        if v is None:
+            raise ValueError(f"spec field {name!r} is required")
+        if typ is bool:
+            if not isinstance(v, bool):
+                raise ValueError(f"spec field {name!r} must be a bool, got {v!r}")
+        elif typ is float:
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(f"spec field {name!r} must be a number, got {v!r}")
+            v = float(v)
+        elif typ is int:
+            if isinstance(v, bool) or not isinstance(v, int):
+                raise ValueError(f"spec field {name!r} must be an int, got {v!r}")
+        elif typ is str:
+            if not isinstance(v, str) or not v:
+                raise ValueError(f"spec field {name!r} must be a non-empty string")
+        out[name] = v
+    if out["seeds"] < 1 or out["batch"] < 1:
+        raise ValueError("spec needs seeds >= 1 and batch >= 1")
+    if out["stop_on_plateau"] and not out["coverage"]:
+        raise ValueError(
+            "stop_on_plateau needs coverage: the plateau signal IS the "
+            "coverage curve"
+        )
+    return out
+
+
+def spec_to_args(spec: dict, **overrides) -> SimpleNamespace:
+    """The args namespace `__main__._build_engine` / `_stream_batches`
+    expect, built from a job spec. The fleet worker drives the SAME
+    chunked streaming driver the `hunt` CLI uses — one code path, one
+    fingerprint function, one checkpoint format."""
+    ns = SimpleNamespace(
+        **spec,
+        stream=True,
+        no_pipeline=False,
+        segments_per_dispatch=8,
+        dispatch_depth=4,
+        no_donate=False,
+        compile_cache=None,
+        checkpoint=None,
+        stats=None,
+        stats_labels=None,
+        stop_after_batches=0,
+        all_seeds=False,
+        limit=spec.get("shrink_limit", 5),
+    )
+    for k, v in overrides.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def job_fingerprint(spec: dict) -> dict:
+    """The resume-safety fingerprint: the checkpoint machinery's field
+    set computed over the spec, so the job store and the job's
+    `--checkpoint` file refuse drift with one voice."""
+    return fingerprint_from_args(spec_to_args(spec))
+
+
+def spec_sha(spec: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def job_subkey(spec: dict) -> str:
+    """The warm-start cache subkey this job's engine compiles under
+    (compile_cache.cache_subkey over the gate tuple / stream version /
+    lane shape). Computed ONCE at submit with `import_jax=False` (a
+    fixed `jax-unknown-` prefix): the control plane stays jax-free, and
+    the allocator only needs EQUALITY to pack same-compile jobs
+    back-to-back — jax's internal key still discriminates versions for
+    the persistent cache entries themselves."""
+    from ..compile_cache import cache_subkey
+
+    return cache_subkey(
+        import_jax=False,
+        gates={
+            "flight_recorder": spec["flight_recorder"],
+            "coverage": spec["coverage"],
+            "provenance": spec["provenance"],
+        },
+        rng_stream=spec["rng_stream"],
+        lanes=spec["batch"],
+        segment_steps=SEGMENT_STEPS,
+    )
+
+
+def engine_key(spec: dict) -> str:
+    """Everything that shapes the COMPILED streaming program (model,
+    vocabulary, gates, lane shape) — jobs with equal keys can share one
+    live Engine instance in a worker. Seed budget/cursor are excluded:
+    they are runtime inputs, not compiled structure."""
+    fields = (
+        "machine", "nodes", "horizon", "queue", "faults", "loss",
+        "fault_tmax", "fault_kinds", "rng_stream", "strict_restart",
+        "coverage", "provenance", "flight_recorder", "batch",
+    )
+    return json.dumps({f: spec[f] for f in fields}, sort_keys=True)
+
+
+# -- the job document --------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Job:
+    id: str
+    spec: dict
+    fingerprint: dict
+    fingerprint_sha: str
+    subkey: str
+    state: str = QUEUED
+    priority: int = 0
+    deadline_ts: Optional[float] = None
+    ts_submit: float = 0.0
+    history: list = dataclasses.field(default_factory=list)
+    lease: Optional[dict] = None
+    cancel_requested: bool = False
+    progress: dict = dataclasses.field(default_factory=dict)
+    result: Optional[dict] = None
+    error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["version"] = 1
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Job":
+        d = dict(d)
+        d.pop("version", None)
+        return Job(**d)
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in TERMINAL
+
+
+class JobStore:
+    """Directory layout under `root`::
+
+        jobs/<id>.json         the job document (atomic writes)
+        jobs/<id>.lock         flock guard for read-modify-write
+        jobs/<id>.ckpt.json    the job's hunt checkpoint (worker-owned)
+        jobs/<id>.stats.*      the job's StatsEmitter feed (jsonl/prom/json)
+        corpus.json            filed finds (corpus.CorpusEntry records)
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.jobs_dir = os.path.join(self.root, "jobs")
+        os.makedirs(self.jobs_dir, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------------
+
+    def job_path(self, job_id: str) -> str:
+        if not re.fullmatch(r"[A-Za-z0-9._-]+", job_id):
+            raise KeyError(f"malformed job id {job_id!r}")
+        return os.path.join(self.jobs_dir, f"{job_id}.json")
+
+    def ckpt_path(self, job_id: str) -> str:
+        return os.path.join(self.jobs_dir, f"{job_id}.ckpt.json")
+
+    def stats_base(self, job_id: str) -> str:
+        return os.path.join(self.jobs_dir, f"{job_id}.stats")
+
+    @property
+    def corpus_path(self) -> str:
+        return os.path.join(self.root, "corpus.json")
+
+    # -- locking + atomic IO -------------------------------------------------
+
+    @contextlib.contextmanager
+    def _locked(self, name: str):
+        path = os.path.join(self.jobs_dir, name + ".lock")
+        f = open(path, "a")
+        try:
+            if fcntl is not None:
+                fcntl.flock(f, fcntl.LOCK_EX)
+            yield
+        finally:
+            if fcntl is not None:
+                fcntl.flock(f, fcntl.LOCK_UN)
+            f.close()
+
+    def _write(self, job: Job) -> None:
+        path = self.job_path(job.id)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(job.to_dict(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    # -- submit / read -------------------------------------------------------
+
+    def submit(self, spec: dict, *, priority: int = 0,
+               deadline_s: Optional[float] = None) -> Job:
+        """Validate + enqueue a job. `deadline_s` is relative seconds
+        from submit; the store records the ABSOLUTE wall deadline."""
+        spec = normalize_spec(spec)
+        now = time.time()
+        with self._locked(".store"):
+            seq = 1 + max(
+                (int(m.group(1)) for m in (
+                    re.match(r"j(\d+)-", fn)
+                    for fn in os.listdir(self.jobs_dir)
+                ) if m),
+                default=0,
+            )
+            sha = spec_sha(spec)
+            job = Job(
+                id=f"j{seq:04d}-{sha[:8]}",
+                spec=spec,
+                fingerprint=job_fingerprint(spec),
+                fingerprint_sha=sha,
+                subkey=job_subkey(spec),
+                priority=int(priority),
+                deadline_ts=(now + float(deadline_s)) if deadline_s else None,
+                ts_submit=round(now, 3),
+                history=[[round(now, 3), QUEUED]],
+            )
+            self._write(job)
+        return job
+
+    def get(self, job_id: str) -> Job:
+        path = self.job_path(job_id)
+        try:
+            with open(path) as f:
+                return Job.from_dict(json.load(f))
+        except FileNotFoundError:
+            raise KeyError(f"no such job {job_id!r}") from None
+
+    def list(self) -> List[Job]:
+        out = []
+        for fn in sorted(os.listdir(self.jobs_dir)):
+            # strict id match: the directory also holds each job's
+            # .ckpt.json checkpoint and .stats.json snapshot
+            m = re.fullmatch(r"(j\d+-[0-9a-f]{8})\.json", fn)
+            if m:
+                with contextlib.suppress(KeyError, json.JSONDecodeError):
+                    out.append(self.get(m.group(1)))
+        return out
+
+    def counts(self) -> Dict[str, int]:
+        c = {s: 0 for s in STATES}
+        for j in self.list():
+            c[j.state] = c.get(j.state, 0) + 1
+        return c
+
+    # -- guarded mutation ----------------------------------------------------
+
+    def _update(self, job_id: str, fn: Callable[[Job], None]) -> Job:
+        with self._locked(job_id):
+            job = self.get(job_id)
+            fn(job)
+            self._write(job)
+        return job
+
+    def transition(self, job_id: str, to: str, *, error: Optional[str] = None,
+                   result: Optional[dict] = None,
+                   progress: Optional[dict] = None) -> Job:
+        """Move a job along the lifecycle; illegal edges raise."""
+        if to not in STATES:
+            raise ValueError(f"unknown state {to!r}")
+
+        def mut(job: Job) -> None:
+            if to not in _TRANSITIONS[job.state]:
+                raise ValueError(
+                    f"illegal transition {job.state} -> {to} for {job.id}"
+                )
+            job.state = to
+            job.history.append([round(time.time(), 3), to])
+            if error is not None:
+                job.error = error
+            if result is not None:
+                job.result = result
+            if progress is not None:
+                job.progress = {**job.progress, **progress}
+            if to in TERMINAL:
+                job.lease = None
+
+        return self._update(job_id, mut)
+
+    def update_progress(self, job_id: str, progress: dict) -> Job:
+        return self._update(
+            job_id, lambda j: j.progress.update(progress)
+        )
+
+    def request_cancel(self, job_id: str) -> Job:
+        """Queued jobs cancel immediately; in-flight jobs get the flag
+        and the worker finalizes at the next unit boundary."""
+
+        def mut(job: Job) -> None:
+            if job.terminal:
+                return
+            job.cancel_requested = True
+            if job.state == QUEUED:
+                job.state = CANCELLED
+                job.history.append([round(time.time(), 3), CANCELLED])
+                job.lease = None
+
+        return self._update(job_id, mut)
+
+    # -- leases --------------------------------------------------------------
+
+    def try_lease(self, job_id: str, worker: str, ttl_s: float) -> Optional[Job]:
+        """Claim (or renew/reclaim) a job for `worker`. Returns the job
+        when the lease is held, None when another worker's unexpired
+        lease blocks it. A worker always reclaims its OWN lease
+        immediately (restart-after-SIGKILL without waiting out the ttl)."""
+        now = time.time()
+        claimed: List[Optional[Job]] = [None]
+
+        def mut(job: Job) -> None:
+            if job.state not in LEASABLE:
+                return
+            lease = job.lease
+            if (lease and lease["worker"] != worker
+                    and lease["expires_ts"] > now):
+                return
+            job.lease = {
+                "worker": worker,
+                "expires_ts": round(now + ttl_s, 3),
+                "ttl_s": ttl_s,
+            }
+            claimed[0] = job
+
+        self._update(job_id, mut)
+        return claimed[0]
+
+    def renew_lease(self, job_id: str, worker: str) -> None:
+        def mut(job: Job) -> None:
+            if job.lease and job.lease["worker"] == worker:
+                job.lease["expires_ts"] = round(
+                    time.time() + job.lease["ttl_s"], 3
+                )
+
+        self._update(job_id, mut)
+
+    # -- drift refusal -------------------------------------------------------
+
+    def fingerprint_mismatch(self, job: Job) -> Optional[str]:
+        """None when the job's spec still hashes to its recorded
+        fingerprint; otherwise a message naming EVERY drifted field —
+        the same shape the checkpoint refusal prints, surfaced verbatim
+        as the job's `failed` reason."""
+        want = job_fingerprint(job.spec)
+        diffs = [
+            f"{f} (recorded {job.fingerprint.get(f)!r}, now {want.get(f)!r})"
+            for f in sorted(set(want) | set(job.fingerprint))
+            if job.fingerprint.get(f) != want.get(f)
+        ]
+        if spec_sha(job.spec) != job.fingerprint_sha and not diffs:
+            diffs = ["spec hash (non-fingerprint field edited)"]
+        if not diffs:
+            return None
+        return (
+            f"job {job.id}: spec drifted since submit — refusing to run; "
+            "differing: " + ", ".join(diffs)
+        )
+
+    # -- live feed -----------------------------------------------------------
+
+    def read_feed(self, job_id: str, last: int = 20) -> List[dict]:
+        """The job's live per-batch coverage/failure feed: the tail of
+        its StatsEmitter JSONL, parsed. Missing file = empty feed (the
+        job has not started streaming yet)."""
+        path = self.stats_base(job_id) + ".jsonl"
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            return []
+        out = []
+        for line in lines[-max(0, last):]:
+            with contextlib.suppress(json.JSONDecodeError):
+                out.append(json.loads(line))
+        return out

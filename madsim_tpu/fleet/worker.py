@@ -1,0 +1,449 @@
+"""The fleet worker — lease, slice, checkpoint, shrink, file.
+
+`python -m madsim_tpu fleet worker --root DIR` turns the store's queue
+into engine time. The loop:
+
+1. **Lease.** Scan the store for leasable jobs (queued, or mid-flight
+   with an expired/own lease — crash recovery), refuse any whose spec
+   drifted from its recorded fingerprint (the checkpoint-refusal
+   discipline, surfaced verbatim as the job's `failed` reason), and let
+   the `LaneAllocator` pick the next work unit — packed by
+   `cache_subkey` so tenants sharing a compile run back-to-back on the
+   warm jit.
+2. **Run one unit.** One unit = one seed batch, driven through the SAME
+   chunked streaming driver the `hunt` CLI uses
+   (`__main__._stream_batches` with `stop_after_batches = done + 1`):
+   the job's fingerprinted `--checkpoint` file advances atomically
+   after every batch, so a `kill -9` anywhere loses at most one batch
+   and the resumed job's final report is byte-identical to an
+   uninterrupted run. Per-batch stats stream to the job's own
+   StatsEmitter feed (label-namespaced for the fleet /metrics).
+3. **Finalize.** On budget exhaustion / coverage plateau / deadline /
+   cancel, close the lifecycle: no finds -> `exhausted`/`plateaued`;
+   finds -> `found` -> `shrink` one representative per distinct fail
+   code (provenance-guided when the gate rode the hunt) -> `shrunk` ->
+   file each as a corpus entry carrying filed-by-job metadata + its
+   minimal repro line + `why` attribution -> `filed`.
+
+Engine reuse: one live Engine per `engine_key` (model + vocabulary +
+gates + lane shape), dropped when the allocator switches subkey groups
+— never two engine configs in flight at once on a 1-core box. A
+PerfRecorder session (`--perf-timeline`) wraps every unit in a
+`fleet_unit` span with the job id, so warm-compile reuse is readable
+straight off the host timeline (the second tenant's unit contains no
+`compile` span at all).
+"""
+
+from __future__ import annotations
+
+# madsim: allow-file(D001) — the worker is host-side service code: it
+# reads the wall clock only for lease renewal, deadline enforcement,
+# idle polling and per-unit throughput logs. Nothing feeds simulation
+# state; a job's results are a pure function of (fingerprint, seed
+# schedule).
+import importlib
+import json
+import logging
+import time
+from typing import List, Optional, Tuple
+
+from .allocator import LaneAllocator
+from .store import (
+    CANCELLED,
+    COMPILING,
+    EXHAUSTED,
+    FAILED,
+    FILED,
+    FOUND,
+    LEASABLE,
+    PLATEAUED,
+    QUEUED,
+    RUNNING,
+    SHRUNK,
+    Job,
+    JobStore,
+    engine_key,
+    spec_to_args,
+)
+
+_LOG = logging.getLogger("madsim_tpu.fleet.worker")
+
+
+class FleetWorker:
+    def __init__(self, root: str, *, worker_id: str = "w0",
+                 lease_ttl_s: float = 60.0, poll_s: float = 0.5):
+        self.store = JobStore(root)
+        self.alloc = LaneAllocator()
+        self.worker_id = worker_id
+        self.lease_ttl_s = lease_ttl_s
+        self.poll_s = poll_s
+        self._engines: dict = {}          # engine_key -> Engine
+        self._engine_subkey: Optional[str] = None
+
+    # -- main loop -----------------------------------------------------------
+
+    def run(self, *, drain: bool = False, max_units: int = 0) -> int:
+        """Serve work units until stopped. `drain=True` exits once every
+        job is terminal (waiting out foreign leases); `max_units=N`
+        exits after N units (deterministic interruption for tests)."""
+        units = 0
+        while True:
+            job = self._lease_next()
+            if job is None:
+                if drain and all(j.terminal for j in self.store.list()):
+                    print(f"worker {self.worker_id}: drained", flush=True)
+                    return 0
+                time.sleep(self.poll_s)
+                continue
+            self._run_unit(job)
+            units += 1
+            if max_units and units >= max_units:
+                print(
+                    f"worker {self.worker_id}: stopping after "
+                    f"{units} unit(s) (--max-units)", flush=True,
+                )
+                return 0
+
+    def _lease_next(self) -> Optional[Job]:
+        now = time.time()
+        cands = []
+        for j in self.store.list():
+            if j.state not in LEASABLE:
+                continue
+            lease = j.lease
+            if (lease and lease["worker"] != self.worker_id
+                    and lease["expires_ts"] > now):
+                continue  # someone else is (still) on it
+            cands.append(j)
+        picked = self.alloc.pick(cands)
+        if picked is None:
+            return None
+        return self.store.try_lease(picked.id, self.worker_id, self.lease_ttl_s)
+
+    # -- one work unit -------------------------------------------------------
+
+    def _run_unit(self, job: Job) -> None:
+        from ..perf.recorder import maybe_span
+
+        job = self.store.get(job.id)  # freshest doc (cancel flag, spec)
+        try:
+            if job.cancel_requested:
+                self._finalize_cancel(job)
+                return
+            drift = self.store.fingerprint_mismatch(job)
+            if drift:
+                self._fail(job, drift)
+                return
+            if job.deadline_ts is not None and time.time() > job.deadline_ts:
+                self._finalize(job, stop_reason="deadline")
+                return
+            ck = self._load_ckpt(job)
+            if ck is not None and ck.get("done"):
+                # a previous worker died between the last batch and
+                # finalization — nothing left to stream, just close out
+                self._finalize(job)
+                return
+            with maybe_span("fleet_unit", job=job.id, subkey=job.subkey):
+                self._stream_one_batch(job, ck)
+        except SystemExit as exc:
+            # the streaming driver refuses drifted checkpoints (and
+            # other contract violations) via sys.exit — surfaced
+            # verbatim as the job's failed reason
+            self._fail(job, str(exc) or "worker aborted (SystemExit)")
+        except KeyboardInterrupt:
+            raise
+        except Exception as exc:  # one broken job must not kill the farm
+            self._fail(job, f"{type(exc).__name__}: {exc}")
+
+    def _stream_one_batch(self, job: Job, ck: Optional[dict]) -> None:
+        from ..__main__ import _stream_batches
+
+        if job.state == QUEUED:
+            job = self.store.transition(job.id, COMPILING)
+        t0 = time.perf_counter()
+        eng, built = self._get_engine(job)
+        batches_done = int(ck["batch"]) if ck else 0
+        args = spec_to_args(
+            job.spec,
+            checkpoint=self.store.ckpt_path(job.id),
+            stats=self.store.stats_base(job.id),
+            stats_labels={"job": job.id},
+            stop_after_batches=batches_done + 1,
+        )
+        _stream_batches(eng, args, purpose="fleet")
+        if job.state == COMPILING:
+            job = self.store.transition(job.id, RUNNING)
+        ck = self._load_ckpt(job)
+        progress = self._progress_from_ckpt(eng, ck)
+        progress["engine"] = "built" if built else "cached"
+        job = self.store.update_progress(job.id, progress)
+        self.store.renew_lease(job.id, self.worker_id)
+        el = time.perf_counter() - t0
+        print(
+            f"unit {job.id}: batch {progress['batches_run']}"
+            f"/{progress['batches_planned']}, "
+            f"{progress['completed']} seeds total in {el:.1f}s, "
+            f"engine {progress['engine']}, "
+            f"{progress['failing']} failing so far",
+            flush=True,
+        )
+        if ck and ck.get("done"):
+            self._finalize(job)
+
+    # -- engines -------------------------------------------------------------
+
+    def _get_engine(self, job: Job) -> Tuple[object, bool]:
+        """One live Engine per engine_key; the cache is flushed when the
+        allocator moves to a different subkey group, so at most one
+        compile family stays resident on the 1-core box."""
+        if job.subkey != self._engine_subkey:
+            self._engines.clear()
+            self._engine_subkey = job.subkey
+        key = engine_key(job.spec)
+        eng = self._engines.get(key)
+        if eng is not None:
+            return eng, False
+        from ..__main__ import _build_engine
+
+        eng = _build_engine(spec_to_args(job.spec))
+        self._engines[key] = eng
+        return eng, True
+
+    # -- checkpoint plumbing -------------------------------------------------
+
+    def _load_ckpt(self, job: Job) -> Optional[dict]:
+        from ..runtime.checkpoint import load_checkpoint
+
+        return load_checkpoint(self.store.ckpt_path(job.id))
+
+    def _progress_from_ckpt(self, eng, ck: Optional[dict]) -> dict:
+        if ck is None:
+            return {"batches_run": 0, "batches_planned": None,
+                    "completed": 0, "seeds_consumed": 0, "failing": 0,
+                    "infra": 0, "abandoned": 0, "plateau": False,
+                    "coverage_slots": None}
+        cov_slots = None
+        if ck.get("cov_b64"):
+            from ..runtime.coverage import decode_map
+
+            cov_slots = int(
+                decode_map(ck["cov_b64"], eng.config.cov_slots_log2).sum()
+            )
+        return {
+            "batches_run": int(ck["batch"]),
+            "batches_planned": int(ck["planned"]),
+            "completed": int(ck["completed"]),
+            "seeds_consumed": int(ck["seeds_consumed"]),
+            "failing": len(ck["failing"]),
+            "infra": len(ck["infra"]),
+            "abandoned": len(ck["abandoned"]),
+            "plateau": bool(ck.get("plateau", False)),
+            "coverage_slots": cov_slots,
+        }
+
+    # -- finalization --------------------------------------------------------
+
+    def _finalize_cancel(self, job: Job) -> None:
+        ck = self._load_ckpt(job)
+        report = self._report_from_ckpt(ck, "cancelled")
+        self.store.transition(
+            job.id, CANCELLED, result={"report": report, "finds": []}
+        )
+        print(f"job {job.id}: cancelled "
+              f"({report['completed']} seeds run)", flush=True)
+
+    def _report_from_ckpt(self, ck: Optional[dict], stop_reason: str) -> dict:
+        """The deterministic half of a job's result: everything here is
+        a pure function of (fingerprint, seed schedule) — no wall
+        times — so an interrupted+resumed job's report is byte-identical
+        to an uninterrupted run's (asserted in tests and CI). Coverage
+        slots are filled in by the caller when an engine exists to
+        decode the map (cancel can land before any engine does)."""
+        if ck is None:
+            return {"batches_run": 0, "batches_planned": None,
+                    "completed": 0, "seeds_consumed": 0, "failing": [],
+                    "infra": [], "abandoned": 0, "plateau": False,
+                    "coverage_slots": None, "stop_reason": stop_reason}
+        return {
+            "batches_run": int(ck["batch"]),
+            "batches_planned": int(ck["planned"]),
+            "completed": int(ck["completed"]),
+            "seeds_consumed": int(ck["seeds_consumed"]),
+            "failing": sorted([int(s), int(c)] for s, c in ck["failing"]),
+            "infra": sorted([int(s), int(c)] for s, c in ck["infra"]),
+            "abandoned": len(ck["abandoned"]),
+            "plateau": bool(ck.get("plateau", False)),
+            "coverage_slots": None,
+            "stop_reason": stop_reason,
+        }
+
+    def _finalize(self, job: Job, stop_reason: Optional[str] = None) -> None:
+        ck = self._load_ckpt(job)
+        if stop_reason is None:
+            stop_reason = (
+                "plateau" if (ck and ck.get("plateau")) else "exhausted"
+            )
+        report = self._report_from_ckpt(ck, stop_reason)
+        failing = [(int(s), int(c)) for s, c in (ck["failing"] if ck else [])]
+        if ck and ck.get("cov_b64"):
+            from ..runtime.coverage import decode_map
+
+            eng, _built = self._get_engine(job)
+            report["coverage_slots"] = int(
+                decode_map(ck["cov_b64"], eng.config.cov_slots_log2).sum()
+            )
+        if job.state == QUEUED:
+            # deadline hit before the first unit ever ran
+            job = self.store.transition(job.id, COMPILING)
+        if job.state == COMPILING:
+            job = self.store.transition(job.id, RUNNING)
+        if not failing:
+            final = PLATEAUED if stop_reason == "plateau" else EXHAUSTED
+            self.store.transition(
+                job.id, final, result={"report": report, "finds": []}
+            )
+            print(f"job {job.id}: {final} ({report['completed']} seeds, "
+                  f"0 failing, stop={stop_reason})", flush=True)
+            return
+        job = self.store.transition(job.id, FOUND, progress={
+            "failing": len(failing),
+        })
+        eng, _built = self._get_engine(job)
+        finds = self._shrink_finds(job, eng, ck)
+        job = self.store.transition(job.id, SHRUNK)
+        filed = self._file_finds(job, finds)
+        self.store.transition(job.id, FILED, result={
+            "report": report,
+            "finds": finds,
+            "corpus": self.store.corpus_path,
+            "corpus_added": filed,
+        })
+        print(
+            f"job {job.id}: filed {filed} corpus entr"
+            f"{'y' if filed == 1 else 'ies'} from {len(failing)} failing "
+            f"seeds (stop={stop_reason})", flush=True,
+        )
+
+    # -- shrink + why + corpus ----------------------------------------------
+
+    def _shrink_finds(self, job: Job, eng, ck: dict) -> List[dict]:
+        """One representative per distinct fail code (the hunt CLI's
+        dedup discipline), shrunk with the device-harvested provenance
+        word seeding the candidate order, with `why`-style attribution
+        decoded from the same word."""
+        shrink_mod = importlib.import_module("madsim_tpu.engine.shrink")
+        from ..__main__ import fault_kinds_str
+
+        spec = job.spec
+        prov = {int(k): int(v) for k, v in (ck.get("prov") or {}).items()}
+        by_code: dict = {}
+        for seed, code in ck["failing"]:
+            by_code.setdefault(int(code), []).append(int(seed))
+        reps = [(seeds[0], code) for code, seeds in sorted(by_code.items())]
+        reps = reps[: spec["shrink_limit"]]
+        finds: List[dict] = []
+        for seed, code in reps:
+            doc: dict = {"seed": seed, "code": code}
+            try:
+                sr = shrink_mod.shrink(
+                    eng, seed, max_steps=spec["max_steps"],
+                    prov_word=prov.get(seed),
+                )
+            except ValueError as exc:
+                # device-flagged but not reproducing on the host replay:
+                # record the drift (itself a finding), keep the job going
+                doc["error"] = str(exc)
+                finds.append(doc)
+                continue
+            f = sr.shrunk.faults
+            doc["note"] = sr.summary()
+            doc["max_steps"] = sr.steps + 1
+            doc["shrunk"] = sr.shrunk
+            doc["repro"] = (
+                f"python -m madsim_tpu replay --machine {spec['machine']} "
+                f"--seed {seed} --nodes {spec['nodes']} "
+                f"--horizon {sr.shrunk.horizon_us / 1e6} "
+                f"--queue {sr.shrunk.queue_capacity} "
+                f"--faults {f.n_faults} --fault-tmax {f.t_max_us} "
+                f"--loss {sr.shrunk.packet_loss_rate} "
+                f"--max-steps {sr.steps} "
+                f"--fault-kinds {fault_kinds_str(f)} "
+                + ("--strict-restart " if f.strict_restart else "")
+                + f"--rng-stream {sr.shrunk.rng_stream}"
+            )
+            if seed in prov:
+                from ..engine.provenance import implicated
+
+                att = implicated(eng, seed, prov[seed])
+                doc["why"] = {
+                    "prov_word": prov[seed],
+                    "kinds": list(att.kinds),
+                    "faults": [
+                        {"index": ft.index, "kind": ft.kind_name,
+                         "t_apply_us": ft.t_apply_us,
+                         "t_undo_us": ft.t_undo_us, "target": ft.target}
+                        for ft in att.faults
+                    ],
+                }
+            finds.append(doc)
+        return finds
+
+    def _file_finds(self, job: Job, finds: List[dict]) -> int:
+        """File each shrunk find as a corpus entry in the fleet corpus,
+        carrying filed-by-job provenance in its meta (which
+        `audit.record_entry` preserves alongside the environment
+        fingerprint). Returns how many entries were added."""
+        from ..__main__ import build_machine
+        from ..engine import audit, corpus
+
+        added = 0
+        with self.store._locked(".corpus"):
+            entries = corpus.load(self.store.corpus_path)
+            known = {e.key for e in entries}
+            for doc in finds:
+                sr_cfg = doc.pop("shrunk", None)
+                if sr_cfg is None:
+                    continue  # shrink refused (host-replay drift)
+                entry = corpus.CorpusEntry(
+                    machine=job.spec["machine"],
+                    nodes=job.spec["nodes"],
+                    seed=doc["seed"],
+                    fail_code=doc["code"],
+                    status=corpus.STATUS_OPEN,
+                    config=sr_cfg,
+                    max_steps=doc["max_steps"],
+                    note=doc["note"],
+                    meta={
+                        "filed_by": {
+                            "job": job.id,
+                            "worker": self.worker_id,
+                            "fingerprint_sha": job.fingerprint_sha,
+                        },
+                        "repro": doc["repro"],
+                        **(
+                            {"why_kinds": doc["why"]["kinds"]}
+                            if "why" in doc else {}
+                        ),
+                    },
+                )
+                doc["corpus_key"] = list(entry.key)
+                if entry.key in known:
+                    doc["corpus_status"] = "duplicate"
+                    continue
+                entry, _trail = audit.record_entry(entry, build_machine)
+                known.add(entry.key)
+                entries.append(entry)
+                doc["corpus_status"] = "added"
+                added += 1
+            if added:
+                corpus.save(self.store.corpus_path, entries)
+        return added
+
+    # -- failure -------------------------------------------------------------
+
+    def _fail(self, job: Job, reason: str) -> None:
+        _LOG.error("job %s failed: %s", job.id, reason)
+        print(f"job {job.id}: FAILED — {reason}", flush=True)
+        job = self.store.get(job.id)
+        if job.state in (QUEUED, COMPILING, RUNNING, FOUND, SHRUNK):
+            self.store.transition(job.id, FAILED, error=reason)

@@ -83,13 +83,22 @@ def load_checkpoint(path: str) -> Optional[dict]:
 
 def check_fingerprint(ckpt: dict, args) -> Optional[str]:
     """None when the checkpoint belongs to this command line; otherwise
-    a human-readable description of the first mismatch."""
+    a human-readable description naming EVERY field that differs
+    (model, kinds, gates, lanes, ...) — a drifted resume usually drifts
+    several fields at once, and the fleet worker surfaces this message
+    verbatim as the job's `failed` reason, so it must diagnose in one
+    shot rather than one refusal per rerun."""
     want = fingerprint_from_args(args)
     got = ckpt.get("fingerprint", {})
-    for field in _FINGERPRINT_FIELDS:
-        if got.get(field) != want.get(field):
-            return (
-                f"checkpoint was recorded with {field}="
-                f"{got.get(field)!r}, this run has {want.get(field)!r}"
-            )
-    return None
+    diffs = [
+        f"{field} (checkpoint {got.get(field)!r} != this run "
+        f"{want.get(field)!r})"
+        for field in _FINGERPRINT_FIELDS
+        if got.get(field) != want.get(field)
+    ]
+    if not diffs:
+        return None
+    return (
+        "checkpoint belongs to a different run — refusing to resume; "
+        "differing: " + ", ".join(diffs)
+    )
