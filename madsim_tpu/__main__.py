@@ -1355,46 +1355,101 @@ def cmd_fleet(args) -> int:
     if sub == "serve":
         from .fleet import api
 
-        return api.serve(args.root, args.addr, port_file=args.port_file)
+        return api.serve(args.root, args.addr, port_file=args.port_file,
+                         sweep_interval_s=args.sweep_interval)
     if sub == "worker":
         from .fleet.worker import FleetWorker
 
+        driver = None
+        if args.driver == "synthetic":
+            from .fleet.chaos import synthetic_driver as driver
         worker = FleetWorker(
             args.root,
             worker_id=args.worker_id or f"w{os.getpid()}",
             lease_ttl_s=args.lease_ttl,
             poll_s=args.poll,
+            max_attempts=args.max_attempts,
+            backoff_base_s=args.backoff_base,
+            driver=driver,
+            reclaim=not args.no_reclaim,
         )
         return worker.run(drain=args.drain, max_units=args.max_units)
+    if sub == "fsck":
+        from .fleet import fsck as fsck_mod
+
+        rep = fsck_mod.fsck(
+            args.root,
+            fix=not args.dry_run,
+            reclaim=args.reclaim,
+            release_quarantined=args.release_quarantined,
+        )
+        if args.json:
+            print(json.dumps(rep, indent=1, sort_keys=True))
+        else:
+            print(fsck_mod.render(rep))
+        # lint-style exit: 0 clean, 1 when corruption was found (even
+        # if a fixing run just quarantined it — the operator should
+        # look at the .corrupt files)
+        return 1 if rep["corrupt"] else 0
+    if sub == "chaos":
+        from .fleet import chaos as chaos_mod
+
+        failures = []
+        for chaos_seed in range(args.seed, args.seed + max(1, args.sweep)):
+            res = chaos_mod.run_chaos(
+                chaos_seed,
+                profile=args.profile,
+                out_dir=args.out,
+                real=args.real,
+                rounds=args.rounds or None,
+                jobs=args.jobs or None,
+                keep=args.keep,
+            )
+            if not res["ok"]:
+                failures.append(res)
+        if failures:
+            for res in failures:
+                print(f"chaos seed {res['seed']}: "
+                      f"{len(res['violations'])} violation(s)")
+            return 1
+        n = max(1, args.sweep)
+        print(f"fleet chaos: {n} seed(s) ok "
+              f"(profile {args.profile}, first seed {args.seed})")
+        return 0
     from .fleet import client
 
     try:
         addr = client.resolve_addr(args.addr, getattr(args, "port_file", None))
+        retries = 0 if getattr(args, "no_retry", False) else client.DEFAULT_RETRIES
         if sub == "submit":
             from .fleet.store import SPEC_FIELDS
 
             spec = {k: getattr(args, k) for k in SPEC_FIELDS}
             out = client.submit(
-                addr, spec, priority=args.priority, deadline_s=args.deadline
+                addr, spec, priority=args.priority,
+                deadline_s=args.deadline, retries=retries,
             )
             # stdout is exactly the job id — script-composable
             # (`JOB=$(python -m madsim_tpu fleet submit ...)`)
             print(out["id"])
             return 0
         if sub == "status":
-            print(json.dumps(client.status(addr, args.job, feed=args.feed),
-                             indent=1, sort_keys=True))
+            print(json.dumps(
+                client.status(addr, args.job, feed=args.feed,
+                              retries=retries),
+                indent=1, sort_keys=True))
             return 0
         if sub == "result":
-            doc = client.result(addr, args.job)
+            doc = client.result(addr, args.job, retries=retries)
             print(json.dumps(doc, indent=1, sort_keys=True))
             return 0 if doc.get("state") != "failed" else 1
         if sub == "cancel":
-            print(json.dumps(client.cancel(addr, args.job),
+            print(json.dumps(client.cancel(addr, args.job, retries=retries),
                              indent=1, sort_keys=True))
             return 0
         if sub == "queue":
-            print(json.dumps(client.queue(addr), indent=1, sort_keys=True))
+            print(json.dumps(client.queue(addr, retries=retries),
+                             indent=1, sort_keys=True))
             return 0
         raise AssertionError(f"unhandled fleet verb {sub!r}")
     except (client.FleetClientError, RuntimeError, OSError) as exc:
@@ -2026,6 +2081,12 @@ def main(argv=None) -> int:
             help="resolve the daemon as 127.0.0.1:<port read from PATH> "
             "(the file `fleet serve --port-file` writes atomically)",
         )
+        q.add_argument(
+            "--no-retry", action="store_true",
+            help="fail fast instead of retrying transient HTTP errors "
+            "(connection refused during a server restart, 502/503/504) "
+            "with seeded-jitter backoff",
+        )
 
     q = fl.add_parser("serve", help="jax-free HTTP control plane over a fleet root")
     obs_flags(q)
@@ -2035,6 +2096,12 @@ def main(argv=None) -> int:
     q.add_argument(
         "--port-file", default=None, metavar="PATH",
         help="atomically write the realized port to PATH after binding",
+    )
+    q.add_argument(
+        "--sweep-interval", type=float, default=5.0,
+        help="seconds between lease-reclamation supervisor sweeps "
+        "(expired worker leases requeue their jobs with backoff, or "
+        "quarantine at the attempt cap; 0 disables)",
     )
     q.set_defaults(fn=cmd_fleet)
 
@@ -2071,6 +2138,28 @@ def main(argv=None) -> int:
         help="record the worker's host timeline (per-unit fleet_unit "
         "spans with job ids wrapping the usual compile/dispatch/poll "
         "spans) as Perfetto trace_event JSON",
+    )
+    q.add_argument(
+        "--max-attempts", type=int, default=3,
+        help="consecutive deaths/hard failures before a job is "
+        "quarantined as poison (exception + batch index + repro "
+        "recorded on the job)",
+    )
+    q.add_argument(
+        "--backoff-base", type=float, default=2.0,
+        help="requeue backoff base: a job that died attempt k waits "
+        "base * 2^(k-1) seconds before it can be leased again",
+    )
+    q.add_argument(
+        "--no-reclaim", action="store_true",
+        help="skip the lease-reclamation sweep at each poll (rely on "
+        "`fleet serve`'s supervisor thread / `fleet fsck --reclaim`)",
+    )
+    q.add_argument(
+        "--driver", choices=("real", "synthetic"), default="real",
+        help="'synthetic' replaces the jitted streaming path with the "
+        "jax-free deterministic stand-in (chaos harness / farm tests "
+        "only: same checkpoint+stats machinery, no engine)",
     )
     q.set_defaults(fn=cmd_fleet)
 
@@ -2125,6 +2214,67 @@ def main(argv=None) -> int:
     fleet_client_flags(q)
     q.set_defaults(fn=cmd_fleet)
 
+    q = fl.add_parser(
+        "fsck",
+        help="scan the job store + fleet corpus for truncated/"
+        "unparseable/fingerprint-inconsistent files, quarantine them "
+        "to *.corrupt with a per-file verdict, remove stale atomic-"
+        "write tmp files, and rebuild the queue counts; exit 0 clean "
+        "/ 1 corruption found",
+    )
+    obs_flags(q)
+    fleet_root(q)
+    q.add_argument("--dry-run", action="store_true",
+                   help="scan + report only; quarantine/remove nothing")
+    q.add_argument("--reclaim", action="store_true",
+                   help="also run the lease-reclamation sweep (requeue "
+                   "jobs whose worker lease expired, or quarantine at "
+                   "the attempt cap)")
+    q.add_argument("--release-quarantined", action="store_true",
+                   help="re-queue quarantined jobs (attempt counter "
+                   "reset; the quarantine post-mortem stays on the "
+                   "doc)")
+    q.add_argument("--json", action="store_true",
+                   help="machine-readable report instead of text")
+    q.set_defaults(fn=cmd_fleet)
+
+    q = fl.add_parser(
+        "chaos",
+        help="attack a scratch farm with a seeded schedule of process-"
+        "level faults (SIGKILL worker/server at the k-th store write, "
+        "torn in-flight writes, lease-clock jumps, client calls "
+        "through a bounced server) and assert the recovery "
+        "invariants: no accepted job lost, every resumed job's final "
+        "report byte-identical to an unperturbed oracle run; a "
+        "failing seed reproduces from its printed line forever",
+    )
+    obs_flags(q)
+    q.add_argument("--seed", type=int, default=0,
+                   help="chaos schedule seed (the repro key)")
+    q.add_argument("--sweep", type=int, default=1,
+                   help="run N consecutive seeds starting at --seed")
+    q.add_argument("--profile", choices=("kill", "torn", "mixed"),
+                   default="mixed",
+                   help="fault-mix weighting of the schedule")
+    q.add_argument("--rounds", type=int, default=0,
+                   help="override the schedule's round count (0 = from "
+                   "the seed)")
+    q.add_argument("--jobs", type=int, default=0,
+                   help="override the number of tenant jobs (0 = from "
+                   "the seed)")
+    q.add_argument("--real", action="store_true",
+                   help="drive real echo-machine engines instead of "
+                   "the jax-free synthetic driver (slow: each worker "
+                   "restart pays a jax import; finds are filed and "
+                   "regress-replayed)")
+    q.add_argument("--out", default=None, metavar="DIR",
+                   help="keep the farm, schedule.json and fsck.json "
+                   "under DIR (default: a temp dir, removed when the "
+                   "seed passes)")
+    q.add_argument("--keep", action="store_true",
+                   help="keep the scratch farm even on success")
+    q.set_defaults(fn=cmd_fleet)
+
     p = sub.add_parser(
         "lint",
         help="static determinism & contract analysis: D-rules "
@@ -2155,9 +2305,15 @@ def main(argv=None) -> int:
         # `bench report` renders history with no jax import at all
         args.cmd == "bench" and getattr(args, "action", None) == "report"
     ) or (
-        # the whole fleet control plane (serve + client verbs) is
-        # jax-free by contract; only the worker runs engines
-        args.cmd == "fleet" and args.fleet_cmd != "worker"
+        # the whole fleet control plane (serve + client verbs + fsck +
+        # chaos orchestration) is jax-free by contract; only a worker
+        # with the real driver runs engines — the chaos harness's
+        # synthetic-driver workers stay jax-free so a fleet-chaos round
+        # costs milliseconds, not a jax import per incarnation
+        args.cmd == "fleet" and (
+            args.fleet_cmd != "worker"
+            or getattr(args, "driver", "real") == "synthetic"
+        )
     )
     if getattr(args, "multihost", False):
         # distributed init must precede ANY backend access — including

@@ -139,11 +139,12 @@ def load(path: str) -> List[CorpusEntry]:
 
 
 def save(path: str, entries: List[CorpusEntry]) -> None:
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
-        json.dump({"version": 1, "entries": [e.to_dict() for e in entries]}, f, indent=2)
-        f.write("\n")
-    os.replace(tmp, path)
+    from ..runtime.atomicio import atomic_write_json
+
+    atomic_write_json(
+        path, {"version": 1, "entries": [e.to_dict() for e in entries]},
+        indent=2, sort_keys=False,
+    )
 
 
 def add(path: str, entry: CorpusEntry) -> bool:

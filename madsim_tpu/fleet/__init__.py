@@ -32,7 +32,31 @@ daemon:
   `http.server`, extending the `serve --service stats` pattern) with
   POST /jobs, GET /jobs/{id} (live per-batch feed), GET
   /jobs/{id}/result, DELETE /jobs/{id}, GET /queue, /metrics,
-  /healthz; `fleet submit|status|result|cancel|queue` wrap it.
+  /healthz; `fleet submit|status|result|cancel|queue` wrap it, each
+  retrying transient errors with seeded-jitter backoff. The server
+  runs the lease-reclamation supervisor sweep; /healthz reports store
+  integrity (a read-only fsck scan), queue depth, stale leases and
+  quarantined jobs.
+* `fsck` — the store doctor: per-file verdicts over every artifact
+  (truncated/unparseable/fingerprint-inconsistent -> quarantined to
+  `*.corrupt`; stale atomic-write tmps removed; queue counts rebuilt),
+  plus `--reclaim` and `--release-quarantined`.
+* `chaos` — the farm tested with its own medicine: one seeded RNG
+  derives a schedule of worker SIGKILLs at the k-th store write, torn
+  in-flight writes, checkpoint corruption, lease-clock jumps and
+  server bounces, then asserts no accepted job lost, byte-identical
+  recovery vs an unperturbed oracle farm, and a clean final fsck; a
+  failing seed reproduces forever from its printed line.
+
+Self-healing (PR 12): expired leases requeue their jobs with
+exponential backoff (checkpoint preserved — <=1 batch lost across
+worker REPLACEMENT, not just restart); N consecutive deaths or hard
+failures quarantine a poison job with its exception, batch index and
+exact repro command instead of wedging the farm; OOM-class failures
+halve the lane count (re-deriving the warm-compile subkey) before
+burning poison attempts; every durable write is fsync'd atomic
+(`runtime/atomicio`), and every reader tolerates a torn file by
+construction (typed errors, lenient quarantining checkpoint loads).
 
 The determinism contract makes the farm auditable: any job's find
 replays from its recorded repro line alone (`regress` on the fleet

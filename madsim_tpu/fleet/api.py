@@ -13,9 +13,14 @@ read-only files, no sim/jax imports) to a read/write job API::
                              (409 until the job reaches a terminal state)
     DELETE /jobs/{id}        cancel (queued dies now; running at the next
                              unit boundary)
-    GET    /metrics          Prometheus: fleet gauges + every job's own
-                             StatsEmitter textfile, label-namespaced
-    GET    /healthz          liveness
+    GET    /metrics          Prometheus: fleet gauges (job states,
+                             requeues/lease-reclaims/quarantine) +
+                             every job's own StatsEmitter textfile,
+                             label-namespaced
+    GET    /healthz          liveness + store integrity (read-only fsck
+                             scan: corrupt files, queue depth, stale
+                             leases, quarantined jobs; 503 when the
+                             store needs `fleet fsck`)
 
 Everything the API serves is an atomic-rename artifact (job docs,
 StatsEmitter snapshots), so no response can observe a torn write — and
@@ -33,10 +38,11 @@ import json
 import logging
 import os
 import re
+import threading
 from typing import Optional, Tuple
 
 from . import httpd
-from .store import JobStore, STATES, TERMINAL
+from .store import CorruptJobFile, JobStore, STATES, TERMINAL
 
 _LOG = logging.getLogger("madsim_tpu.fleet.api")
 
@@ -79,7 +85,7 @@ class FleetAPI:
         path = path.rstrip("/") or "/"
         try:
             if path == "/healthz" and method == "GET":
-                return 200, "text/plain", b"ok\n"
+                return self._healthz()
             if path == "/metrics" and method == "GET":
                 return 200, "text/plain; version=0.0.4", self._metrics()
             if path in ("/queue", "/jobs") and method == "GET":
@@ -104,6 +110,10 @@ class FleetAPI:
             return _err(404, str(exc.args[0]) if exc.args else "not found")
         except ValueError as exc:
             return _err(400, str(exc))
+        except CorruptJobFile as exc:
+            # a torn/garbled document on disk is an operator problem,
+            # never an unhandled 500: name the file and the fix
+            return _err(503, str(exc))
 
     # -- endpoints -----------------------------------------------------------
 
@@ -167,6 +177,36 @@ class FleetAPI:
             "cancel_requested": job.cancel_requested,
         })
 
+    # -- health --------------------------------------------------------------
+
+    def _healthz(self) -> Tuple[int, str, bytes]:
+        """Liveness + store integrity in one probe: a read-only fsck
+        scan (per-file verdicts summarized, nothing mutated) plus the
+        farm gauges. 200 only while every artifact is readable; a
+        corrupt store answers 503 with the count and the fix, so a
+        `curl -f` health check trips exactly when `fleet fsck` has
+        work to do."""
+        from . import fsck
+
+        rep = fsck.scan(self.store)
+        ok = rep["corrupt"] == 0
+        doc = {
+            "ok": ok,
+            "store": {
+                "files_scanned": rep["files_scanned"],
+                "corrupt_files": rep["corrupt"],
+                "drifted_jobs": rep["drifted"],
+                "stale_tmp": rep["stale_tmp"],
+                "torn_tails": rep["torn_tails"],
+            },
+            "queue_depth": rep["queue_depth"],
+            "stale_leases": rep["stale_leases"],
+            "quarantined_jobs": rep["quarantined"],
+            **({} if ok else {"fix": "run `fleet fsck --root "
+                              f"{self.store.root}`"}),
+        }
+        return _json(200 if ok else 503, doc)
+
     # -- metrics -------------------------------------------------------------
 
     def _metrics(self) -> bytes:
@@ -175,12 +215,33 @@ class FleetAPI:
         worker (`{job="<id>"}`), so concatenation is a valid exposition
         — `# TYPE` lines are deduped across files."""
         lines = ["# madsim_tpu fleet control plane"]
+        jobs = self.store.list()
         counts = self.store.counts()
         lines.append("# TYPE madsim_tpu_fleet_jobs gauge")
         for s in STATES:
             lines.append(f'madsim_tpu_fleet_jobs{{state="{s}"}} {counts.get(s, 0)}')
-        seen_types = {"madsim_tpu_fleet_jobs"}
-        for job in self.store.list():
+        # the self-healing counters: requeues (all causes), lease
+        # reclaims (the sweep's share of them) and the quarantine gauge
+        lines.append("# TYPE madsim_tpu_fleet_requeues_total counter")
+        lines.append(
+            f"madsim_tpu_fleet_requeues_total "
+            f"{sum(j.n_requeues for j in jobs)}"
+        )
+        lines.append("# TYPE madsim_tpu_fleet_lease_reclaims_total counter")
+        lines.append(
+            f"madsim_tpu_fleet_lease_reclaims_total "
+            f"{sum(j.n_lease_reclaims for j in jobs)}"
+        )
+        lines.append("# TYPE madsim_tpu_fleet_quarantined_jobs gauge")
+        lines.append(
+            f"madsim_tpu_fleet_quarantined_jobs "
+            f"{counts.get('quarantined', 0)}"
+        )
+        seen_types = {"madsim_tpu_fleet_jobs",
+                      "madsim_tpu_fleet_requeues_total",
+                      "madsim_tpu_fleet_lease_reclaims_total",
+                      "madsim_tpu_fleet_quarantined_jobs"}
+        for job in jobs:
             prom = self.store.stats_base(job.id) + ".prom"
             if not os.path.exists(prom):
                 continue
@@ -227,16 +288,47 @@ def make_handler(api: FleetAPI):
     return Handler
 
 
-def serve(root: str, addr: str, port_file: Optional[str] = None) -> int:
+def serve(root: str, addr: str, port_file: Optional[str] = None,
+          sweep_interval_s: float = 5.0) -> int:
     """`fleet serve` entry: bind (port 0 supported), announce the
     realized port (stdout + optional --port-file), serve until
-    SIGTERM/Ctrl-C, close gracefully."""
+    SIGTERM/Ctrl-C, close gracefully. A daemon supervisor thread runs
+    the lease-reclamation sweep every `sweep_interval_s` (0 disables):
+    expired worker leases requeue their jobs with backoff — or
+    quarantine at the attempt cap — so the farm heals even while no
+    worker is alive to sweep for itself."""
     store = JobStore(root)
+    stop = threading.Event()
+
+    def _sweep() -> None:
+        while not stop.wait(sweep_interval_s):
+            try:
+                for act in store.reclaim_expired():
+                    print(
+                        f"sweep: reclaimed {act['job']} from dead "
+                        f"worker {act['worker']} -> {act['outcome']} "
+                        f"(attempt {act['attempt']})", flush=True,
+                    )
+            except Exception:  # the farm outlives a bad sweep pass
+                _LOG.exception("lease-reclamation sweep failed")
+
     srv, host, port = httpd.bind(addr, make_handler(FleetAPI(store)))
     print(
         f"fleet control plane on {host}:{port} (root {store.root}; "
         f"GET /queue /jobs/{{id}} /jobs/{{id}}/result /metrics /healthz, "
-        f"POST /jobs, DELETE /jobs/{{id}})",
+        f"POST /jobs, DELETE /jobs/{{id}}; lease sweep every "
+        f"{sweep_interval_s:g}s)",
         flush=True,
     )
-    return httpd.run_http_server(srv, port_file=port_file)
+    sweeper = None
+    if sweep_interval_s > 0:
+        sweeper = threading.Thread(
+            target=_sweep, daemon=True, name="fleet-lease-sweep"
+        )
+        sweeper.start()
+    try:
+        return httpd.run_http_server(srv, port_file=port_file)
+    finally:
+        stop.set()
+        if sweeper is not None:
+            sweeper.join(timeout=2)

@@ -1,0 +1,583 @@
+"""The fleet-chaos harness — test the farm with its own medicine.
+
+The repo's whole thesis (PAPER.md) is that recovery code is exactly the
+code you cannot trust until you have injected every failure
+deterministically. The fleet IS recovery code — leases, requeues,
+quarantine, fsck — so it gets the same treatment the simulated
+protocols get: a seeded schedule of process-level faults, derived from
+ONE RNG so a failing seed reproduces forever, with the invariants
+checked after every schedule:
+
+* **no accepted job is ever lost** — every submitted job reaches a
+  real terminal state with a result, never `failed`/`cancelled`, and a
+  healthy job quarantined by genuinely-consecutive deaths is released
+  and completes;
+* **byte-identical recovery** — each job's final `result.report` is
+  byte-identical to an unperturbed oracle farm's run of the same spec
+  (the PR-11 resume guarantee, now across worker replacement, torn
+  writes and lease-clock jumps);
+* **the store heals** — the final fsck leaves zero corrupt files and
+  zero stale tmp files;
+* (`--real` only) **every filed find still `regress`-replays**.
+
+The fault vocabulary (`derive_schedule`):
+
+``kill_worker``   SIGKILL the worker at its k-th store write (injected
+                  at the shared `runtime/atomicio` write point — "at
+                  step k" is an instrumented, replayable place, not a
+                  wall-clock race)
+``torn_write``    the kill lands mid-write: b bytes of the k-th payload
+                  reach the tmp file, the rename never runs — the
+                  atomicity claim under test is that the final path
+                  keeps its previous version
+``corrupt_ckpt``  external corruption: truncate a checkpoint's FINAL
+                  file at byte b (what a dying disk — not the farm's
+                  own fsync'd writes — can produce); the lenient reader
+                  must quarantine it and restart the stream
+``lease_jump``    jump the lease clock: expire every live lease on
+                  disk, then run the reclamation sweep (requeue with
+                  backoff / quarantine at the cap)
+``server_bounce`` SIGKILL `fleet serve`, issue a client verb INTO the
+                  outage (the seeded-jitter retry must carry it), then
+                  restart the server on the same port — a bounce-window
+                  submit grows the accepted-jobs set the invariants
+                  track
+``clean_units``   run k units with no fault (progress resets the
+                  consecutive-attempt counter — quarantine only fires
+                  on genuinely consecutive deaths)
+
+By default workers run the jax-free **synthetic driver** below — the
+deterministic stand-in for `_stream_batches` that drives the REAL
+checkpoint, stats-emitter and store machinery (the farm paths under
+test) without an engine, so one chaos round costs milliseconds and a
+32-seed sweep is a lunch break, not a day. `--real` swaps in echo-
+machine engines end to end.
+
+Jax-free by contract (the orchestrator and the synthetic driver import
+no engine code); `random.Random(seed)` is the repo-sanctioned seeded
+constructor.
+"""
+
+from __future__ import annotations
+
+# madsim: allow-file(D001) — the orchestrator babysits real processes:
+# subprocess timeouts, bounce windows and drain deadlines are host
+# wall-clock by nature. Nothing here feeds simulation state; the
+# schedule itself is a pure function of the seed.
+import contextlib
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+from . import client as fleet_client
+from . import fsck as fsck_mod
+from .store import (
+    FAILED,
+    CANCELLED,
+    QUARANTINED,
+    TERMINAL,
+    JobStore,
+)
+
+CHAOS_ENV = "MADSIM_TPU_FLEET_CHAOS"
+
+#: action weights per profile (satellite: CI pins one kill-heavy and
+#: one torn-heavy seed)
+_PROFILES = {
+    "kill": (("kill_worker", 5), ("torn_write", 1), ("corrupt_ckpt", 1),
+             ("lease_jump", 2), ("server_bounce", 1), ("clean_units", 2)),
+    "torn": (("kill_worker", 1), ("torn_write", 5), ("corrupt_ckpt", 2),
+             ("lease_jump", 1), ("server_bounce", 1), ("clean_units", 2)),
+    "mixed": (("kill_worker", 2), ("torn_write", 2), ("corrupt_ckpt", 1),
+              ("lease_jump", 2), ("server_bounce", 1), ("clean_units", 2)),
+}
+
+
+# -- the synthetic driver ----------------------------------------------------
+
+
+def synthetic_driver(worker, job, args) -> None:
+    """Deterministic jax-free stand-in for one `_stream_batches` unit.
+
+    Everything the farm touches is REAL — the fingerprinted checkpoint
+    (strict load + `check_fingerprint` refusal, atomic save), the
+    per-job StatsEmitter feed, the store lifecycle the caller drives —
+    only the engine between them is simulated: batch results are a pure
+    function of (spec, batch index), which is exactly the determinism
+    contract the byte-identical oracle invariant needs.
+
+    Magic machine names (farm test fixtures):
+
+    * ``chaos-poison``  raises every attempt once batch index 1 (the
+      second batch) is reached — the canonical poison job
+    * ``chaos-oom``     raises an OOM-marked error while ``batch`` > 16
+      — exercises the lane-count backoff
+    * ``chaos-find``    one deterministic failing seed in batch 0 —
+      exercises found -> shrunk -> filed under chaos
+    """
+    import sys as _sys
+
+    from ..runtime.checkpoint import (
+        check_fingerprint,
+        fingerprint_from_args,
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from ..tracing import StatsEmitter
+
+    spec = job.spec
+    ck = load_checkpoint(args.checkpoint)
+    if ck is not None:
+        err = check_fingerprint(ck, args)
+        if err:
+            _sys.exit(f"--checkpoint {args.checkpoint}: {err}")
+    bi = int(ck["batch"]) if ck else 0
+    machine = spec["machine"]
+    if machine == "chaos-poison" and bi >= 1:
+        raise RuntimeError(
+            f"poison: model raised in batch {bi + 1} (synthetic fixture)"
+        )
+    if machine == "chaos-oom" and spec["batch"] > 16:
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: out of memory allocating synthetic "
+            f"buffer at {spec['batch']} lanes (fixture)"
+        )
+    planned = -(-spec["seeds"] // spec["batch"])
+    chunk = min(spec["batch"], spec["seeds"] - bi * spec["batch"])
+    completed = (int(ck["completed"]) if ck else 0) + chunk
+    cursor = (int(ck["cursor"]) if ck else spec["seed"]) + chunk
+    failing = [tuple(x) for x in ck["failing"]] if ck else []
+    if machine == "chaos-find" and bi == 0:
+        failing.append((spec["seed"] + 3, 7))
+    done = completed >= spec["seeds"]
+    emitter = StatsEmitter(args.stats, labels=args.stats_labels)
+    emitter.emit({
+        "kind": "fleet_batch", "machine": machine, "batch": bi + 1,
+        "batches": planned, "completed": completed,
+        "batch_completed": chunk, "failing": len(failing), "infra": 0,
+        "abandoned": 0,
+    })
+    if done:
+        emitter.emit({
+            "kind": "fleet_summary", "machine": machine,
+            "completed": completed, "failing": len(failing), "infra": 0,
+            "abandoned": 0, "batches_run": bi + 1,
+            "batches_planned": planned, "plateau": False,
+        })
+    emitter.close()
+    save_checkpoint(args.checkpoint, {
+        "fingerprint": fingerprint_from_args(args),
+        "batch": bi + 1, "planned": planned, "cursor": cursor,
+        "completed": completed, "seeds_consumed": completed,
+        "failing": [list(x) for x in failing], "infra": [],
+        "abandoned": [], "prov": {}, "cov_b64": None, "detector": None,
+        "plateau": False, "done": done,
+    })
+
+
+# -- schedule derivation -----------------------------------------------------
+
+
+def derive_schedule(seed: int, *, profile: str = "mixed",
+                    rounds: Optional[int] = None,
+                    jobs: Optional[int] = None,
+                    real: bool = False) -> dict:
+    """The whole attack, derived up front from one RNG — printed,
+    persisted as `schedule.json`, and replayable from the seed alone."""
+    if profile not in _PROFILES:
+        raise ValueError(f"unknown profile {profile!r}; "
+                         f"known: {sorted(_PROFILES)}")
+    rng = random.Random(f"fleet-chaos-{seed}")
+    n_jobs = jobs or rng.randint(2, 3)
+    specs = []
+    for i in range(n_jobs):
+        if real:
+            spec = {"machine": "echo", "seeds": 64, "batch": 32,
+                    "faults": 0, "horizon": 1.0, "max_steps": 300}
+        else:
+            spec = {
+                "machine": rng.choice(("chaos-echo", "chaos-find")),
+                "seeds": rng.choice((48, 96)),
+                "batch": rng.choice((16, 32)),
+                "faults": 0,
+            }
+        specs.append(spec)
+    actions, weights = zip(*_PROFILES[profile])
+    n_rounds = rounds or rng.randint(5, 8)
+    events: List[dict] = []
+    for i in range(n_rounds):
+        action = rng.choices(actions, weights=weights, k=1)[0]
+        ev: dict = {"round": i, "action": action}
+        if action == "kill_worker":
+            ev["at_write"] = rng.randint(1, 16)
+        elif action == "torn_write":
+            ev["at_write"] = rng.randint(1, 16)
+            ev["at_byte"] = rng.randint(0, 200)
+        elif action == "corrupt_ckpt":
+            ev["job_index"] = rng.randrange(n_jobs)
+            ev["at_byte"] = rng.randint(0, 160)
+        elif action == "server_bounce":
+            ev["verb"] = rng.choice(("queue", "submit"))
+            if ev["verb"] == "submit":
+                ev["spec"] = (
+                    {"machine": "echo", "seeds": 64, "batch": 32,
+                     "faults": 0, "horizon": 1.0, "max_steps": 300}
+                    if real else
+                    {"machine": "chaos-echo", "seeds": 48, "batch": 16,
+                     "faults": 0}
+                )
+        elif action == "clean_units":
+            ev["units"] = rng.randint(1, 3)
+        events.append(ev)
+    return {"seed": seed, "profile": profile, "real": real,
+            "specs": specs, "events": events}
+
+
+# -- process plumbing --------------------------------------------------------
+
+
+def _start_server(root: str, port_file: str,
+                  addr: str = "127.0.0.1:0") -> subprocess.Popen:
+    with contextlib.suppress(OSError):
+        os.remove(port_file)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "madsim_tpu", "fleet", "serve",
+         "--root", root, "--addr", addr, "--port-file", port_file,
+         # the harness drives reclamation itself (lease_jump events) so
+         # same-seed runs keep a deterministic attempt history; the
+         # sweep thread has its own in-process tests
+         "--sweep-interval", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    return proc
+
+
+def _run_worker(root: str, *, chaos: Optional[dict] = None,
+                max_units: int = 0,
+                real: bool = False, backoff_base_s: float = 0.05,
+                lease_ttl_s: float = 30.0,
+                timeout_s: float = 120.0) -> subprocess.CompletedProcess:
+    """One worker incarnation. An armed chaos plan makes it SIGKILL
+    itself at the scheduled write (rc -9); otherwise it exits 0 after
+    draining / its unit budget."""
+    cmd = [sys.executable, "-m", "madsim_tpu", "fleet", "worker",
+           "--root", root, "--worker-id", "chaos-w", "--poll", "0.02",
+           "--lease-ttl", str(lease_ttl_s),
+           "--backoff-base", str(backoff_base_s),
+           # always drain-capable: a unit-budgeted round on an already-
+           # finished farm must exit, not idle-poll into the timeout
+           "--drain"]
+    if not real:
+        cmd += ["--driver", "synthetic"]
+    if max_units:
+        cmd += ["--max-units", str(max_units)]
+    env = dict(os.environ)
+    env.pop(CHAOS_ENV, None)
+    if chaos is not None:
+        env[CHAOS_ENV] = json.dumps(chaos)
+    return subprocess.run(
+        cmd, env=env, timeout=timeout_s,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _expire_leases(root: str) -> int:
+    """The lease-clock jump: rewrite every live lease as already
+    expired (what a suspended worker VM looks like to the farm)."""
+    store = JobStore(root)
+
+    def mut(j) -> None:
+        if j.lease is not None:
+            j.lease["expires_ts"] = 0.0
+
+    n = 0
+    for job in store.list():
+        if job.lease is None:
+            continue
+        store._update(job.id, mut)
+        n += 1
+    return n
+
+
+def _truncate_file(path: str, at_byte: int) -> bool:
+    """External-corruption simulation: cut a FINAL file (never what the
+    farm's own fsync'd atomic writes produce). Clamped below the
+    closing `}\\n` so the result is guaranteed unparseable."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return False
+    with open(path, "r+b") as f:
+        f.truncate(min(at_byte, max(0, size - 3)))
+    return True
+
+
+# -- the orchestrator --------------------------------------------------------
+
+
+def run_chaos(seed: int, *, profile: str = "mixed",
+              out_dir: Optional[str] = None, real: bool = False,
+              rounds: Optional[int] = None, jobs: Optional[int] = None,
+              keep: bool = False, backoff_base_s: float = 0.05,
+              recovery_rounds: int = 8) -> dict:
+    """Run one seeded chaos schedule against a scratch farm and check
+    every invariant. Returns the result dict ({"ok", "violations",
+    ...}); prints the exact reproduction line on failure."""
+    sched = derive_schedule(seed, profile=profile, rounds=rounds,
+                            jobs=jobs, real=real)
+    ephemeral = out_dir is None
+    workdir = (
+        tempfile.mkdtemp(prefix=f"fleet-chaos-{seed}-") if ephemeral
+        else os.path.join(out_dir, f"seed{seed}")
+    )
+    os.makedirs(workdir, exist_ok=True)
+    root = os.path.join(workdir, "farm")
+    oracle_root = os.path.join(workdir, "oracle")
+    port_file = os.path.join(workdir, "serve.port")
+    with open(os.path.join(workdir, "schedule.json"), "w") as f:
+        json.dump(sched, f, indent=1, sort_keys=True)
+    worker_timeout = 600.0 if real else 120.0
+    violations: List[str] = []
+    job_ids: List[str] = []
+    oracle_specs: List[dict] = []
+
+    def _note(msg: str) -> None:
+        print(f"chaos[{seed}]: {msg}", flush=True)
+
+    server = _start_server(root, port_file)
+    try:
+        addr = fleet_client.resolve_addr(None, port_file, wait_s=30.0)
+        for spec in sched["specs"]:
+            job_ids.append(fleet_client.submit(addr, spec)["id"])
+            oracle_specs.append(spec)
+        _note(f"submitted {len(job_ids)} jobs; "
+              f"{len(sched['events'])} scheduled events")
+
+        for ev in sched["events"]:
+            action = ev["action"]
+            if action == "kill_worker":
+                p = _run_worker(
+                    root, chaos={"kill_at_write": ev["at_write"],
+                                 "match": root},
+                    real=real,
+                    backoff_base_s=backoff_base_s,
+                    timeout_s=worker_timeout,
+                )
+                _note(f"round {ev['round']}: kill_worker at write "
+                      f"{ev['at_write']} -> rc {p.returncode}")
+            elif action == "torn_write":
+                p = _run_worker(
+                    root,
+                    chaos={"torn_at_write": [ev["at_write"],
+                                             ev["at_byte"]],
+                           "match": root},
+                    real=real,
+                    backoff_base_s=backoff_base_s,
+                    timeout_s=worker_timeout,
+                )
+                _note(f"round {ev['round']}: torn_write "
+                      f"[{ev['at_write']}, {ev['at_byte']}] -> "
+                      f"rc {p.returncode}")
+            elif action == "corrupt_ckpt":
+                if ev["job_index"] < len(job_ids):
+                    jid = job_ids[ev["job_index"]]
+                    hit = _truncate_file(
+                        JobStore(root).ckpt_path(jid), ev["at_byte"]
+                    )
+                    _note(f"round {ev['round']}: corrupt_ckpt {jid} "
+                          f"at byte {ev['at_byte']} "
+                          f"({'hit' if hit else 'no file yet'})")
+            elif action == "lease_jump":
+                n = _expire_leases(root)
+                acts = fsck_mod.fsck(
+                    root, fix=True, reclaim=True,
+                    backoff_base_s=backoff_base_s,
+                ).get("reclaimed", [])
+                _note(f"round {ev['round']}: lease_jump expired "
+                      f"{n} lease(s), sweep reclaimed {len(acts)}")
+            elif action == "server_bounce":
+                server.send_signal(signal.SIGKILL)
+                server.wait()
+                box: dict = {}
+
+                def _call(ev=ev, box=box) -> None:
+                    try:
+                        if ev["verb"] == "submit":
+                            box["out"] = fleet_client.submit(
+                                addr, ev["spec"]
+                            )
+                        else:
+                            box["out"] = fleet_client.queue(addr)
+                    except Exception as exc:  # surfaced as a violation
+                        box["err"] = f"{type(exc).__name__}: {exc}"
+
+                t = threading.Thread(target=_call, daemon=True)
+                t.start()
+                time.sleep(0.3)  # the call is now inside the outage
+                host_port = addr  # same port: the retry must land
+                server = _start_server(root, port_file,
+                                       addr=host_port)
+                t.join(timeout=30)
+                if t.is_alive() or "err" in box:
+                    violations.append(
+                        f"client {ev['verb']} did not survive the "
+                        f"server bounce: {box.get('err', 'timed out')}"
+                    )
+                elif ev["verb"] == "submit":
+                    job_ids.append(box["out"]["id"])
+                    oracle_specs.append(ev["spec"])
+                _note(f"round {ev['round']}: server_bounce + "
+                      f"{ev['verb']} -> "
+                      f"{box.get('out', {}).get('id', 'ok')}")
+            elif action == "clean_units":
+                p = _run_worker(
+                    root, max_units=ev["units"], real=real,
+                    backoff_base_s=backoff_base_s,
+                    timeout_s=worker_timeout,
+                )
+                _note(f"round {ev['round']}: clean_units "
+                      f"{ev['units']} -> rc {p.returncode}")
+
+        # -- recovery: the farm must converge with no faults armed ----------
+        store = JobStore(root)
+        for r in range(recovery_rounds):
+            fsck_mod.fsck(root, fix=True, reclaim=True,
+                          release_quarantined=True,
+                          backoff_base_s=backoff_base_s)
+            p = _run_worker(root, real=real,
+                            backoff_base_s=backoff_base_s,
+                            timeout_s=worker_timeout)
+            jobs_now = {j.id: j for j in store.list()}
+            missing = [jid for jid in job_ids if jid not in jobs_now]
+            if not missing and all(
+                j.state in TERMINAL and j.state != QUARANTINED
+                for j in jobs_now.values()
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            violations.append(
+                f"farm did not converge in {recovery_rounds} recovery "
+                f"rounds"
+            )
+    finally:
+        with contextlib.suppress(OSError):
+            server.send_signal(signal.SIGKILL)
+            server.wait()
+
+    # -- final fsck must leave a clean store --------------------------------
+    final_rep = fsck_mod.fsck(root, fix=True, reclaim=True)
+    with open(os.path.join(workdir, "fsck.json"), "w") as f:
+        json.dump(final_rep, f, indent=1, sort_keys=True)
+    rescan = fsck_mod.scan(JobStore(root))
+    if rescan["corrupt"] or rescan["stale_tmp"]:
+        violations.append(
+            f"store not clean after fsck: {rescan['corrupt']} corrupt, "
+            f"{rescan['stale_tmp']} stale tmp"
+        )
+
+    # -- invariant: no accepted job lost ------------------------------------
+    store = JobStore(root)
+    reports = {}
+    for jid in job_ids:
+        try:
+            job = store.get(jid)
+        except KeyError:
+            violations.append(f"accepted job {jid} LOST (no document)")
+            continue
+        if job.state not in TERMINAL:
+            violations.append(f"job {jid} not terminal: {job.state}")
+        elif job.state in (FAILED, CANCELLED, QUARANTINED):
+            violations.append(
+                f"job {jid} ended {job.state}: {job.error or job.quarantine}"
+            )
+        elif not job.result or "report" not in job.result:
+            violations.append(f"job {jid} terminal without a report")
+        else:
+            reports[jid] = job.result["report"]
+
+    # -- invariant: byte-identical to the unperturbed oracle ----------------
+    oracle_ids: List[str] = []
+    if not violations:
+        ostore = JobStore(oracle_root)
+        for spec in oracle_specs:
+            oracle_ids.append(ostore.submit(spec).id)
+        _run_worker(oracle_root, real=real,
+                    backoff_base_s=backoff_base_s,
+                    timeout_s=worker_timeout)
+        for jid, oid in zip(job_ids, oracle_ids):
+            try:
+                oracle_report = ostore.get(oid).result["report"]
+            except (KeyError, TypeError):
+                violations.append(f"oracle job {oid} has no report")
+                continue
+            got = json.dumps(reports[jid], sort_keys=True)
+            want = json.dumps(oracle_report, sort_keys=True)
+            if got != want:
+                violations.append(
+                    f"job {jid} report diverged from oracle {oid}:\n"
+                    f"  chaos:  {got}\n  oracle: {want}"
+                )
+
+    # -- invariant (--real): filed finds regress-replay ---------------------
+    corpus = os.path.join(root, "corpus.json")
+    if real and not violations and os.path.exists(corpus):
+        p = subprocess.run(
+            [sys.executable, "-m", "madsim_tpu", "regress",
+             "--corpus", corpus],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=1200,
+        )
+        if p.returncode != 0:
+            violations.append(
+                f"filed finds failed regress replay:\n{p.stdout[-2000:]}"
+            )
+
+    result = {
+        "ok": not violations,
+        "seed": seed,
+        "profile": profile,
+        "violations": violations,
+        "jobs": job_ids,
+        "workdir": workdir,
+        "requeues": sum(j.n_requeues for j in store.list()),
+        "lease_reclaims": sum(j.n_lease_reclaims for j in store.list()),
+    }
+    with open(os.path.join(workdir, "result.json"), "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+    if violations:
+        repro = (
+            f"python -m madsim_tpu fleet chaos --seed {seed} "
+            f"--profile {profile}"
+            + (" --real" if real else "")
+            + (f" --rounds {rounds}" if rounds else "")
+            + (f" --jobs {jobs}" if jobs else "")
+        )
+        print(
+            f"FLEET CHAOS FAILURE (seed {seed}): "
+            f"{len(violations)} violation(s)\n"
+            + "\n".join(f"  - {v}" for v in violations)
+            + f"\nreproduce forever with:\n  {repro}\n"
+            f"artifacts: {workdir}",
+            flush=True,
+        )
+    else:
+        _note(
+            f"ok — {len(job_ids)} jobs survived "
+            f"{len(sched['events'])} faults "
+            f"({result['requeues']} requeues, "
+            f"{result['lease_reclaims']} lease reclaims); reports "
+            f"byte-identical to oracle"
+        )
+        if ephemeral and not keep:
+            shutil.rmtree(workdir, ignore_errors=True)
+            result["workdir"] = None
+    return result

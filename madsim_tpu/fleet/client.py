@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import time
 import urllib.error
 import urllib.request
@@ -22,6 +23,15 @@ from typing import Optional, Tuple
 from . import httpd
 
 DEFAULT_ADDR = "127.0.0.1:8142"
+
+#: HTTP statuses worth retrying: the server is restarting or shedding
+#: load, not rejecting the request. Every other status (400 validation,
+#: 404, 409 not-terminal-yet) fails immediately — retrying a refusal
+#: only hides it.
+TRANSIENT_HTTP = frozenset({502, 503, 504})
+DEFAULT_RETRIES = 5
+RETRY_BACKOFF_S = 0.1
+RETRY_BACKOFF_MAX_S = 2.0
 
 
 class FleetClientError(RuntimeError):
@@ -54,9 +64,8 @@ def resolve_addr(addr: Optional[str] = None,
     return os.environ.get("MADSIM_TPU_FLEET_ADDR", DEFAULT_ADDR)
 
 
-def request(addr: str, method: str, path: str,
-            body: Optional[dict] = None,
-            timeout: float = 30.0) -> Tuple[int, dict]:
+def _request_once(addr: str, method: str, path: str,
+                  body: Optional[dict], timeout: float) -> Tuple[int, dict]:
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(
         f"http://{addr}{path}", data=data, method=method,
@@ -74,30 +83,73 @@ def request(addr: str, method: str, path: str,
         raise FleetClientError(exc.code, msg) from None
 
 
+def request(addr: str, method: str, path: str,
+            body: Optional[dict] = None,
+            timeout: float = 30.0,
+            retries: int = DEFAULT_RETRIES) -> Tuple[int, dict]:
+    """One control-plane call, with transient-failure retry: connection
+    refused/reset (the daemon is restarting — `fleet serve` comes back
+    on the same port-file), socket timeouts, and 502/503/504 are
+    retried up to `retries` times with seeded-jitter exponential
+    backoff; every other HTTP error raises immediately. `retries=0`
+    (the `--no-retry` escape hatch) restores fail-fast.
+
+    The jitter RNG is SEEDED from (method, path) — the repo's
+    discipline extends to its backoff schedules: two runs of the same
+    verb jitter identically, so a chaos failure replays.
+
+    Caveat: a connection cut AFTER the server processed a POST but
+    before the response arrived retries into a second submit (two
+    identical jobs, distinct ids). The store runs both to the same
+    byte-identical report, so the cost is compute, not correctness."""
+    rng = random.Random(f"fleet-client {method} {path}")
+    attempt = 0
+    while True:
+        try:
+            return _request_once(addr, method, path, body, timeout)
+        except FleetClientError as exc:
+            if exc.status not in TRANSIENT_HTTP or attempt >= retries:
+                raise
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError):
+            # URLError wraps ECONNREFUSED during a server restart
+            if attempt >= retries:
+                raise
+        delay = min(RETRY_BACKOFF_S * (2 ** attempt), RETRY_BACKOFF_MAX_S)
+        time.sleep(delay * (0.5 + rng.random()))  # madsim: allow(D001)
+        attempt += 1
+
+
 def submit(addr: str, spec: dict, *, priority: int = 0,
-           deadline_s: Optional[float] = None) -> dict:
+           deadline_s: Optional[float] = None,
+           retries: int = DEFAULT_RETRIES) -> dict:
     doc = {"spec": spec, "priority": priority}
     if deadline_s:
         doc["deadline_s"] = deadline_s
-    _, out = request(addr, "POST", "/jobs", doc)
+    _, out = request(addr, "POST", "/jobs", doc, retries=retries)
     return out
 
 
-def status(addr: str, job_id: str, feed: int = 20) -> dict:
-    _, out = request(addr, "GET", f"/jobs/{job_id}?feed={feed}")
+def status(addr: str, job_id: str, feed: int = 20,
+           retries: int = DEFAULT_RETRIES) -> dict:
+    _, out = request(addr, "GET", f"/jobs/{job_id}?feed={feed}",
+                     retries=retries)
     return out
 
 
-def result(addr: str, job_id: str) -> dict:
-    _, out = request(addr, "GET", f"/jobs/{job_id}/result")
+def result(addr: str, job_id: str,
+           retries: int = DEFAULT_RETRIES) -> dict:
+    _, out = request(addr, "GET", f"/jobs/{job_id}/result",
+                     retries=retries)
     return out
 
 
-def cancel(addr: str, job_id: str) -> dict:
-    _, out = request(addr, "DELETE", f"/jobs/{job_id}")
+def cancel(addr: str, job_id: str,
+           retries: int = DEFAULT_RETRIES) -> dict:
+    _, out = request(addr, "DELETE", f"/jobs/{job_id}", retries=retries)
     return out
 
 
-def queue(addr: str) -> dict:
-    _, out = request(addr, "GET", "/queue")
+def queue(addr: str, retries: int = DEFAULT_RETRIES) -> dict:
+    _, out = request(addr, "GET", "/queue", retries=retries)
     return out

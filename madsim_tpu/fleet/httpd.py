@@ -19,18 +19,16 @@ Stdlib-only (no jax): safe to import from any control-plane process.
 from __future__ import annotations
 
 import http.server
-import os
 import signal
 from typing import Optional, Tuple
 
 
 def write_port_file(path: str, port: int) -> None:
-    """Atomic (tmp + rename): a discovery poller never reads a torn or
-    empty port file."""
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
-        f.write(f"{port}\n")
-    os.replace(tmp, path)
+    """Atomic (shared `runtime/atomicio` discipline): a discovery
+    poller never reads a torn or empty port file."""
+    from ..runtime.atomicio import atomic_write_text
+
+    atomic_write_text(path, f"{port}\n")
 
 
 def read_port_file(path: str) -> int:

@@ -13,6 +13,20 @@ Lifecycle state machine::
                                       found -> shrunk -> filed
     (queued|compiling|running|found) -> cancelled
     (compiling|running|found|shrunk) -> failed
+    (queued|compiling|running|found|shrunk) -> queued       (requeue)
+    (queued|compiling|running|found|shrunk) -> quarantined  (poison)
+    quarantined -> queued                                   (release)
+
+A *requeue* is the supervisor path: an expired worker lease (the worker
+died, or its clock jumped past the ttl) or a worker-reported hard
+failure sends the job back to `queued` with the lease cleared, the
+checkpoint preserved (the next worker resumes at <=1 lost batch) and an
+exponential backoff stamped in `requeue_after_ts`. The `attempt`
+counter counts CONSECUTIVE deaths — any completed unit resets it — and
+at `max_attempts` the job is declared poison and moves to the terminal
+`quarantined` state carrying the last exception, the batch index it
+died in, and the exact repro command, instead of wedging the farm
+forever. `release_quarantined` is the explicit operator edge back.
 
 Every job records the same argument FINGERPRINT the checkpoint
 machinery uses (`runtime/checkpoint.fingerprint_from_args` over the
@@ -42,6 +56,7 @@ import time
 from types import SimpleNamespace
 from typing import Callable, Dict, List, Optional
 
+from ..runtime.atomicio import atomic_write_json
 from ..runtime.checkpoint import fingerprint_from_args
 
 try:  # POSIX file locks guard read-modify-write; no-op elsewhere
@@ -61,26 +76,39 @@ SHRUNK = "shrunk"         # finds minimized, filing pending
 FILED = "filed"           # corpus entries + result written
 CANCELLED = "cancelled"
 FAILED = "failed"
+QUARANTINED = "quarantined"  # poison: N consecutive deaths/hard failures
 
 STATES = (QUEUED, COMPILING, RUNNING, PLATEAUED, EXHAUSTED, FOUND,
-          SHRUNK, FILED, CANCELLED, FAILED)
-TERMINAL = frozenset({PLATEAUED, EXHAUSTED, FILED, CANCELLED, FAILED})
+          SHRUNK, FILED, CANCELLED, FAILED, QUARANTINED)
+TERMINAL = frozenset({PLATEAUED, EXHAUSTED, FILED, CANCELLED, FAILED,
+                      QUARANTINED})
 #: states a worker may hold a lease in (crash recovery re-leases these)
 LEASABLE = frozenset({QUEUED, COMPILING, RUNNING, FOUND, SHRUNK})
 
+#: consecutive deaths/hard failures before a job is declared poison
+MAX_ATTEMPTS = 3
+#: requeue backoff: base * 2^(attempt-1) seconds
+REQUEUE_BACKOFF_BASE_S = 2.0
+
 _TRANSITIONS: Dict[str, frozenset] = {
     # queued -> failed: a job can be refused before compiling (unknown
-    # machine, fingerprint drift detected at lease time)
-    QUEUED: frozenset({COMPILING, CANCELLED, FAILED}),
-    COMPILING: frozenset({RUNNING, FAILED, CANCELLED}),
-    RUNNING: frozenset({PLATEAUED, EXHAUSTED, FOUND, FAILED, CANCELLED}),
-    FOUND: frozenset({SHRUNK, FAILED, CANCELLED}),
-    SHRUNK: frozenset({FILED, FAILED}),
+    # machine, fingerprint drift detected at lease time); queued ->
+    # quarantined: the 3rd lease death can land before the worker ever
+    # reached compiling
+    QUEUED: frozenset({COMPILING, CANCELLED, FAILED, QUARANTINED}),
+    COMPILING: frozenset({RUNNING, FAILED, CANCELLED, QUEUED, QUARANTINED}),
+    RUNNING: frozenset({PLATEAUED, EXHAUSTED, FOUND, FAILED, CANCELLED,
+                        QUEUED, QUARANTINED}),
+    FOUND: frozenset({SHRUNK, FAILED, CANCELLED, QUEUED, QUARANTINED}),
+    SHRUNK: frozenset({FILED, FAILED, QUEUED, QUARANTINED}),
     PLATEAUED: frozenset(),
     EXHAUSTED: frozenset(),
     FILED: frozenset(),
     CANCELLED: frozenset(),
     FAILED: frozenset(),
+    # terminal for every automatic path; the one edge out is the
+    # explicit operator release (`fleet fsck --release-quarantined`)
+    QUARANTINED: frozenset({QUEUED}),
 }
 
 # -- job spec ----------------------------------------------------------------
@@ -211,6 +239,35 @@ def job_subkey(spec: dict) -> str:
     )
 
 
+def repro_cmd(spec: dict, *, batch_index: Optional[int] = None) -> str:
+    """The exact `hunt` command reproducing this job's stream — or,
+    with `batch_index`, the single batch it died in (batch i always
+    consumes the same seed range, so one batch is a complete repro).
+    Recorded verbatim in quarantine documents: a poisoned job must be
+    debuggable from its doc alone, with no farm running."""
+    start, seeds = spec["seed"], spec["seeds"]
+    if batch_index is not None:
+        start = spec["seed"] + batch_index * spec["batch"]
+        seeds = max(1, min(spec["batch"], spec["seeds"] - batch_index * spec["batch"]))
+    parts = [
+        f"python -m madsim_tpu hunt --stream --machine {spec['machine']}",
+        f"--nodes {spec['nodes']}", f"--seed {start}", f"--seeds {seeds}",
+        f"--batch {spec['batch']}", f"--horizon {spec['horizon']}",
+        f"--max-steps {spec['max_steps']}", f"--queue {spec['queue']}",
+        f"--faults {spec['faults']}", f"--loss {spec['loss']}",
+        f"--fault-tmax {spec['fault_tmax']}",
+        f"--fault-kinds {spec['fault_kinds']}",
+        f"--rng-stream {spec['rng_stream']}",
+    ]
+    for flag, key in (("--strict-restart", "strict_restart"),
+                      ("--coverage", "coverage"),
+                      ("--provenance", "provenance"),
+                      ("--flight-recorder", "flight_recorder")):
+        if spec.get(key):
+            parts.append(flag)
+    return " ".join(parts)
+
+
 def engine_key(spec: dict) -> str:
     """Everything that shapes the COMPILED streaming program (model,
     vocabulary, gates, lane shape) — jobs with equal keys can share one
@@ -225,6 +282,19 @@ def engine_key(spec: dict) -> str:
 
 
 # -- the job document --------------------------------------------------------
+
+
+class CorruptJobFile(RuntimeError):
+    """A job document exists on disk but cannot be read (truncated,
+    unparseable, or schema-broken). Raised instead of the raw decode
+    error so every reader can distinguish "no such job" (KeyError)
+    from "run `fleet fsck`" — the API maps this to 503, `list()` skips
+    the file, and fsck quarantines it to `*.corrupt`."""
+
+    def __init__(self, path: str, detail: str):
+        super().__init__(f"{path}: {detail} — run `fleet fsck`")
+        self.path = path
+        self.detail = detail
 
 
 @dataclasses.dataclass
@@ -244,6 +314,25 @@ class Job:
     progress: dict = dataclasses.field(default_factory=dict)
     result: Optional[dict] = None
     error: Optional[str] = None
+    #: consecutive deaths/hard failures since the last completed unit
+    #: (a completed unit resets it — deaths are only poison when
+    #: consecutive)
+    attempt: int = 0
+    #: wall timestamp before which the job may not be leased (requeue
+    #: backoff); None = leasable now
+    requeue_after_ts: Optional[float] = None
+    #: post-mortems of every death [{ts, reason, worker, state,
+    #: error, batch_index, attempt}] — the quarantine doc quotes the
+    #: fatal tail of this list
+    deaths: list = dataclasses.field(default_factory=list)
+    #: OOM lane-count backoff records [{ts, from_batch, to_batch,
+    #: error, worker}]
+    degraded: list = dataclasses.field(default_factory=list)
+    #: set when state == quarantined: {reason, error, batch_index,
+    #: attempts, deaths, repro}
+    quarantine: Optional[dict] = None
+    n_requeues: int = 0
+    n_lease_reclaims: int = 0
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -309,12 +398,11 @@ class JobStore:
             f.close()
 
     def _write(self, job: Job) -> None:
-        path = self.job_path(job.id)
-        tmp = f"{path}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(job.to_dict(), f, indent=1, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, path)
+        # shared crash-safe discipline (tmp + fsync + rename +
+        # dir-fsync): a kill — or a power cut — mid-write leaves the
+        # previous document, and the chaos harness injects its torn
+        # writes at exactly this point
+        atomic_write_json(self.job_path(job.id), job.to_dict())
 
     # -- submit / read -------------------------------------------------------
 
@@ -348,12 +436,20 @@ class JobStore:
         return job
 
     def get(self, job_id: str) -> Job:
+        """Read a job document. Raises KeyError when it does not exist
+        and CorruptJobFile when it exists but cannot be read — a torn
+        or schema-broken file must surface as "run fsck", never as an
+        uncaught decode error deep in a worker or API handler."""
         path = self.job_path(job_id)
         try:
             with open(path) as f:
                 return Job.from_dict(json.load(f))
         except FileNotFoundError:
             raise KeyError(f"no such job {job_id!r}") from None
+        except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+            raise CorruptJobFile(path, f"unparseable JSON ({exc})") from None
+        except TypeError as exc:
+            raise CorruptJobFile(path, f"schema mismatch ({exc})") from None
 
     def list(self) -> List[Job]:
         out = []
@@ -362,7 +458,10 @@ class JobStore:
             # .ckpt.json checkpoint and .stats.json snapshot
             m = re.fullmatch(r"(j\d+-[0-9a-f]{8})\.json", fn)
             if m:
-                with contextlib.suppress(KeyError, json.JSONDecodeError):
+                # a corrupt document never takes the farm down: the
+                # sweep/allocator simply do not see it until fsck
+                # quarantines or an operator repairs it
+                with contextlib.suppress(KeyError, CorruptJobFile):
                     out.append(self.get(m.group(1)))
         return out
 
@@ -406,11 +505,6 @@ class JobStore:
 
         return self._update(job_id, mut)
 
-    def update_progress(self, job_id: str, progress: dict) -> Job:
-        return self._update(
-            job_id, lambda j: j.progress.update(progress)
-        )
-
     def request_cancel(self, job_id: str) -> Job:
         """Queued jobs cancel immediately; in-flight jobs get the flag
         and the worker finalizes at the next unit boundary."""
@@ -431,14 +525,17 @@ class JobStore:
     def try_lease(self, job_id: str, worker: str, ttl_s: float) -> Optional[Job]:
         """Claim (or renew/reclaim) a job for `worker`. Returns the job
         when the lease is held, None when another worker's unexpired
-        lease blocks it. A worker always reclaims its OWN lease
-        immediately (restart-after-SIGKILL without waiting out the ttl)."""
+        lease blocks it or the job is in requeue backoff. A worker
+        always reclaims its OWN lease immediately (restart-after-
+        SIGKILL without waiting out the ttl)."""
         now = time.time()
         claimed: List[Optional[Job]] = [None]
 
         def mut(job: Job) -> None:
             if job.state not in LEASABLE:
                 return
+            if job.requeue_after_ts and job.requeue_after_ts > now:
+                return  # still backing off from its last death
             lease = job.lease
             if (lease and lease["worker"] != worker
                     and lease["expires_ts"] > now):
@@ -461,6 +558,212 @@ class JobStore:
                 )
 
         self._update(job_id, mut)
+
+    # -- deaths, requeue, quarantine -----------------------------------------
+
+    def note_progress(self, job_id: str, worker: str, progress: dict) -> Job:
+        """A unit completed: merge progress, reset the consecutive-
+        failure counter (deaths are only poison when consecutive) and
+        renew the lease — one locked write, so the worker's per-unit
+        store-write sequence stays deterministic for the chaos
+        harness's write counter."""
+
+        def mut(job: Job) -> None:
+            job.progress = {**job.progress, **progress}
+            job.attempt = 0
+            job.requeue_after_ts = None
+            if job.lease and job.lease["worker"] == worker:
+                job.lease["expires_ts"] = round(
+                    time.time() + job.lease["ttl_s"], 3
+                )
+
+        return self._update(job_id, mut)
+
+    def record_death(self, job_id: str, *, reason: str,
+                     worker: Optional[str] = None,
+                     error: Optional[str] = None,
+                     batch_index: Optional[int] = None,
+                     max_attempts: int = MAX_ATTEMPTS,
+                     backoff_base_s: float = REQUEUE_BACKOFF_BASE_S,
+                     lease_reclaim: bool = False,
+                     require_expired_lease: bool = False) -> Optional[Job]:
+        """One worker death (expired lease) or worker-reported hard
+        failure on this job: bump the consecutive-attempt counter and
+        either requeue with exponential backoff — checkpoint preserved,
+        so the next worker resumes at <=1 lost batch — or, at
+        `max_attempts`, quarantine with the full post-mortem (last
+        exception, batch index, repro command). Returns the updated job,
+        or None when the guarded re-check made this a no-op (e.g. the
+        lease was renewed between the sweep's scan and the lock)."""
+        now = time.time()
+        done: List[Optional[Job]] = [None]
+
+        def mut(job: Job) -> None:
+            if job.state not in LEASABLE:
+                return
+            if require_expired_lease and not (
+                job.lease and job.lease["expires_ts"] <= now
+            ):
+                return
+            job.attempt += 1
+            if lease_reclaim:
+                job.n_lease_reclaims += 1
+            job.deaths.append({
+                "ts": round(now, 3),
+                "reason": reason,
+                "worker": worker,
+                "state": job.state,
+                "error": error,
+                "batch_index": batch_index,
+                "attempt": job.attempt,
+            })
+            job.lease = None
+            if error is not None:
+                job.error = error
+            if job.attempt >= max_attempts:
+                job.quarantine = {
+                    "reason": (
+                        f"{job.attempt} consecutive failed attempts "
+                        f"({reason})"
+                    ),
+                    "error": error,
+                    "batch_index": batch_index,
+                    "attempts": job.attempt,
+                    "deaths": job.deaths[-max_attempts:],
+                    "repro": repro_cmd(job.spec, batch_index=batch_index),
+                }
+                job.state = QUARANTINED
+                job.history.append([round(now, 3), QUARANTINED])
+                job.requeue_after_ts = None
+            else:
+                job.n_requeues += 1
+                job.requeue_after_ts = round(
+                    now + backoff_base_s * (2 ** (job.attempt - 1)), 3
+                )
+                if job.state != QUEUED:
+                    job.state = QUEUED
+                    job.history.append([round(now, 3), QUEUED])
+            done[0] = job
+
+        self._update(job_id, mut)
+        return done[0]
+
+    def reclaim_expired(self, *, max_attempts: int = MAX_ATTEMPTS,
+                        backoff_base_s: float = REQUEUE_BACKOFF_BASE_S
+                        ) -> List[dict]:
+        """The supervisor sweep: every non-terminal job whose worker
+        lease expired is a worker death — requeue it (or quarantine at
+        the attempt cap) via `record_death`. Runs in `fleet serve`'s
+        sweep thread, in `fleet fsck --reclaim`, and at the top of every
+        worker lease poll, so a farm with ANY live component reclaims.
+        Returns one action record per reclaimed job."""
+        now = time.time()
+        actions = []
+        for job in self.list():
+            if job.state not in LEASABLE or not job.lease:
+                continue
+            if job.lease["expires_ts"] > now:
+                continue
+            dead_worker = job.lease["worker"]
+            out = self.record_death(
+                job.id,
+                reason="lease expired",
+                worker=dead_worker,
+                error=job.error,
+                batch_index=self._ckpt_batch(job.id),
+                max_attempts=max_attempts,
+                backoff_base_s=backoff_base_s,
+                lease_reclaim=True,
+                require_expired_lease=True,
+            )
+            if out is not None:
+                actions.append({
+                    "job": out.id,
+                    "worker": dead_worker,
+                    "outcome": out.state,
+                    "attempt": out.attempt,
+                    "requeue_after_ts": out.requeue_after_ts,
+                })
+        return actions
+
+    def release_quarantined(self, job_id: str) -> Job:
+        """The explicit operator edge out of quarantine: back to
+        `queued` with the attempt counter reset. The quarantine
+        post-mortem stays on the document (audit trail) until a fresh
+        quarantine overwrites it."""
+
+        def mut(job: Job) -> None:
+            if job.state != QUARANTINED:
+                raise ValueError(
+                    f"job {job.id} is {job.state}, not quarantined"
+                )
+            job.state = QUEUED
+            job.history.append([round(time.time(), 3), QUEUED])
+            job.attempt = 0
+            job.requeue_after_ts = None
+            job.n_requeues += 1
+
+        return self._update(job_id, mut)
+
+    def degrade_lanes(self, job_id: str, *, error: str,
+                      worker: Optional[str] = None) -> Job:
+        """OOM lane-count backoff: halve the job's `batch` and requeue
+        it, instead of burning attempts on a shape that cannot
+        allocate. `batch` is a fingerprint field, so the fingerprint /
+        spec sha / warm-compile subkey are re-derived and re-recorded
+        (a deliberate, audited re-spec — NOT silent drift), and the old
+        checkpoint — whose fingerprint no longer matches — is removed:
+        the job restarts its seed schedule at the smaller shape.
+        Correctness over progress; the degradation is recorded in
+        `job.degraded`."""
+        new_batch: List[int] = [0]
+
+        def mut(job: Job) -> None:
+            if job.terminal:
+                return
+            nb = max(1, job.spec["batch"] // 2)
+            new_batch[0] = nb
+            job.degraded.append({
+                "ts": round(time.time(), 3),
+                "from_batch": job.spec["batch"],
+                "to_batch": nb,
+                "error": error,
+                "worker": worker,
+            })
+            job.spec = {**job.spec, "batch": nb}
+            job.fingerprint = job_fingerprint(job.spec)
+            job.fingerprint_sha = spec_sha(job.spec)
+            job.subkey = job_subkey(job.spec)
+            job.lease = None
+            job.requeue_after_ts = None
+            job.n_requeues += 1
+            if job.state != QUEUED:
+                job.state = QUEUED
+                job.history.append([round(time.time(), 3), QUEUED])
+
+        out = self._update(job_id, mut)
+        with contextlib.suppress(OSError):
+            os.remove(self.ckpt_path(job_id))
+        return out
+
+    def _ckpt_batch(self, job_id: str) -> Optional[int]:
+        """Best-effort batch index from the job's checkpoint (for death
+        post-mortems); None when there is no readable checkpoint."""
+        try:
+            with open(self.ckpt_path(job_id)) as f:
+                return int(json.load(f).get("batch", 0))
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def stale_leases(self) -> int:
+        """How many non-terminal jobs hold an expired lease right now
+        (the `/healthz` gauge; the next sweep will reclaim them)."""
+        now = time.time()
+        return sum(
+            1 for j in self.list()
+            if j.state in LEASABLE and j.lease
+            and j.lease["expires_ts"] <= now
+        )
 
     # -- drift refusal -------------------------------------------------------
 

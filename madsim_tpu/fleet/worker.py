@@ -32,6 +32,27 @@ PerfRecorder session (`--perf-timeline`) wraps every unit in a
 `fleet_unit` span with the job id, so warm-compile reuse is readable
 straight off the host timeline (the second tenant's unit contains no
 `compile` span at all).
+
+Self-healing (the failure taxonomy — every path seeded-fault-tested by
+`fleet chaos`):
+
+* **Lease deaths.** Every lease poll starts with the store's
+  `reclaim_expired` sweep: a job whose worker lease expired is requeued
+  (checkpoint preserved — the next worker resumes at <=1 lost batch)
+  with exponential backoff, or quarantined after `--max-attempts`
+  consecutive deaths.
+* **Hard failures** (engine raise): one poison attempt each —
+  requeue/quarantine as above, with exception + batch index + exact
+  repro command recorded on the job.
+* **OOM-class failures**: lane-count backoff first — halve `batch`,
+  re-derive the warm-compile subkey, record the degradation, reset the
+  (now fingerprint-mismatched) checkpoint — before burning poison
+  attempts; below MIN_DEGRADED_BATCH lanes OOM counts as hard.
+* **Deterministic refusals** (fingerprint drift, SystemExit contract
+  violations) go straight to `failed`: retrying cannot help.
+* **Torn checkpoints** (external corruption — the fsync'd atomic
+  writes never produce one) are quarantined to `*.corrupt` and the
+  stream restarts from batch 0 instead of wedging in a refusal loop.
 """
 
 from __future__ import annotations
@@ -44,8 +65,9 @@ from __future__ import annotations
 import importlib
 import json
 import logging
+import os
 import time
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from .allocator import LaneAllocator
 from .store import (
@@ -56,27 +78,56 @@ from .store import (
     FILED,
     FOUND,
     LEASABLE,
+    MAX_ATTEMPTS,
     PLATEAUED,
+    QUARANTINED,
     QUEUED,
+    REQUEUE_BACKOFF_BASE_S,
     RUNNING,
     SHRUNK,
     Job,
     JobStore,
     engine_key,
+    repro_cmd,
     spec_to_args,
 )
 
 _LOG = logging.getLogger("madsim_tpu.fleet.worker")
 
+#: substrings marking an allocation-class failure (jax surfaces device
+#: OOM as XlaRuntimeError with a RESOURCE_EXHAUSTED status); these get
+#: the lane-count backoff retry instead of burning poison attempts
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "Out of memory",
+                "OutOfMemory")
+
+#: below this lane count OOM stops degrading and counts as a hard
+#: failure — halving forever just hides a leak
+MIN_DEGRADED_BATCH = 8
+
 
 class FleetWorker:
     def __init__(self, root: str, *, worker_id: str = "w0",
-                 lease_ttl_s: float = 60.0, poll_s: float = 0.5):
+                 lease_ttl_s: float = 60.0, poll_s: float = 0.5,
+                 max_attempts: int = MAX_ATTEMPTS,
+                 backoff_base_s: float = REQUEUE_BACKOFF_BASE_S,
+                 driver: Optional[Callable] = None,
+                 reclaim: bool = True):
         self.store = JobStore(root)
         self.alloc = LaneAllocator()
         self.worker_id = worker_id
         self.lease_ttl_s = lease_ttl_s
         self.poll_s = poll_s
+        self.max_attempts = max_attempts
+        self.backoff_base_s = backoff_base_s
+        #: optional batch-unit driver `(worker, job, args) -> None` that
+        #: replaces the jitted `_stream_batches` path — the chaos
+        #: harness's jax-free synthetic driver plugs in here; it must
+        #: drive the SAME checkpoint + stats machinery
+        self.driver = driver
+        #: run the lease-reclamation sweep before every lease poll, so
+        #: a farm whose only live component is a worker still requeues
+        #: (the `fleet serve` sweep thread covers the other deployment)
+        self.reclaim = reclaim
         self._engines: dict = {}          # engine_key -> Engine
         self._engine_subkey: Optional[str] = None
 
@@ -105,11 +156,23 @@ class FleetWorker:
                 return 0
 
     def _lease_next(self) -> Optional[Job]:
+        if self.reclaim:
+            for act in self.store.reclaim_expired(
+                max_attempts=self.max_attempts,
+                backoff_base_s=self.backoff_base_s,
+            ):
+                print(
+                    f"reclaimed {act['job']} from dead worker "
+                    f"{act['worker']} -> {act['outcome']} "
+                    f"(attempt {act['attempt']})", flush=True,
+                )
         now = time.time()
         cands = []
         for j in self.store.list():
             if j.state not in LEASABLE:
                 continue
+            if j.requeue_after_ts and j.requeue_after_ts > now:
+                continue  # requeue backoff still running
             lease = j.lease
             if (lease and lease["worker"] != self.worker_id
                     and lease["expires_ts"] > now):
@@ -147,21 +210,19 @@ class FleetWorker:
                 self._stream_one_batch(job, ck)
         except SystemExit as exc:
             # the streaming driver refuses drifted checkpoints (and
-            # other contract violations) via sys.exit — surfaced
-            # verbatim as the job's failed reason
+            # other contract violations) via sys.exit — deterministic
+            # refusals, so retrying is pointless: surfaced verbatim as
+            # the job's failed reason
             self._fail(job, str(exc) or "worker aborted (SystemExit)")
         except KeyboardInterrupt:
             raise
         except Exception as exc:  # one broken job must not kill the farm
-            self._fail(job, f"{type(exc).__name__}: {exc}")
+            self._hard_failure(job, exc)
 
     def _stream_one_batch(self, job: Job, ck: Optional[dict]) -> None:
-        from ..__main__ import _stream_batches
-
         if job.state == QUEUED:
             job = self.store.transition(job.id, COMPILING)
         t0 = time.perf_counter()
-        eng, built = self._get_engine(job)
         batches_done = int(ck["batch"]) if ck else 0
         args = spec_to_args(
             job.spec,
@@ -170,14 +231,23 @@ class FleetWorker:
             stats_labels={"job": job.id},
             stop_after_batches=batches_done + 1,
         )
-        _stream_batches(eng, args, purpose="fleet")
+        if self.driver is not None:
+            self.driver(self, job, args)
+            eng, engine_label = None, "synthetic"
+        else:
+            from ..__main__ import _stream_batches
+
+            eng, built = self._get_engine(job)
+            _stream_batches(eng, args, purpose="fleet")
+            engine_label = "built" if built else "cached"
         if job.state == COMPILING:
             job = self.store.transition(job.id, RUNNING)
         ck = self._load_ckpt(job)
         progress = self._progress_from_ckpt(eng, ck)
-        progress["engine"] = "built" if built else "cached"
-        job = self.store.update_progress(job.id, progress)
-        self.store.renew_lease(job.id, self.worker_id)
+        progress["engine"] = engine_label
+        # one locked write: merge progress, reset the consecutive-
+        # failure counter (this unit completed), renew the lease
+        job = self.store.note_progress(job.id, self.worker_id, progress)
         el = time.perf_counter() - t0
         print(
             f"unit {job.id}: batch {progress['batches_run']}"
@@ -212,9 +282,33 @@ class FleetWorker:
     # -- checkpoint plumbing -------------------------------------------------
 
     def _load_ckpt(self, job: Job) -> Optional[dict]:
-        from ..runtime.checkpoint import load_checkpoint
+        """The FLEET's checkpoint reader is lenient by construction: a
+        torn or schema-broken checkpoint (external corruption — the
+        fsync'd atomic writes never produce one) is quarantined to
+        `*.corrupt` and the job restarts its stream from batch 0,
+        instead of wedging the farm in a refusal loop. The CLI's
+        `--checkpoint` path keeps the strict loader — there the file
+        was named deliberately and silence would throw away a hunt."""
+        from ..runtime.checkpoint import CKPT_REQUIRED_KEYS, load_checkpoint
 
-        return load_checkpoint(self.store.ckpt_path(job.id))
+        path = self.store.ckpt_path(job.id)
+        try:
+            ck = load_checkpoint(path)
+            if ck is not None and not CKPT_REQUIRED_KEYS <= ck.keys():
+                missing = sorted(CKPT_REQUIRED_KEYS - ck.keys())
+                raise ValueError(f"checkpoint missing keys {missing}")
+        except (ValueError, json.JSONDecodeError) as exc:
+            corrupt = path + ".corrupt"
+            os.replace(path, corrupt)
+            _LOG.error("job %s: checkpoint unreadable (%s) — quarantined "
+                       "to %s", job.id, exc, corrupt)
+            print(
+                f"job {job.id}: checkpoint unreadable ({exc}) — "
+                f"quarantined to {corrupt}; restarting the stream from "
+                f"batch 0", flush=True,
+            )
+            return None
+        return ck
 
     def _progress_from_ckpt(self, eng, ck: Optional[dict]) -> dict:
         if ck is None:
@@ -223,7 +317,7 @@ class FleetWorker:
                     "infra": 0, "abandoned": 0, "plateau": False,
                     "coverage_slots": None}
         cov_slots = None
-        if ck.get("cov_b64"):
+        if eng is not None and ck.get("cov_b64"):
             from ..runtime.coverage import decode_map
 
             cov_slots = int(
@@ -285,7 +379,7 @@ class FleetWorker:
             )
         report = self._report_from_ckpt(ck, stop_reason)
         failing = [(int(s), int(c)) for s, c in (ck["failing"] if ck else [])]
-        if ck and ck.get("cov_b64"):
+        if self.driver is None and ck and ck.get("cov_b64"):
             from ..runtime.coverage import decode_map
 
             eng, _built = self._get_engine(job)
@@ -308,10 +402,27 @@ class FleetWorker:
         job = self.store.transition(job.id, FOUND, progress={
             "failing": len(failing),
         })
-        eng, _built = self._get_engine(job)
-        finds = self._shrink_finds(job, eng, ck)
-        job = self.store.transition(job.id, SHRUNK)
-        filed = self._file_finds(job, finds)
+        if self.driver is not None:
+            # synthetic driver (chaos harness): exercise the found ->
+            # shrunk -> filed lifecycle deterministically without an
+            # engine — finds carry their repro line but are not filed
+            # in the corpus (no EngineConfig exists to record)
+            by_code: dict = {}
+            for seed, code in failing:
+                by_code.setdefault(int(code), []).append(int(seed))
+            finds = [
+                {"seed": seeds[0], "code": code,
+                 "repro": repro_cmd(job.spec),
+                 "note": "synthetic driver find (not filed)"}
+                for code, seeds in sorted(by_code.items())
+            ]
+            job = self.store.transition(job.id, SHRUNK)
+            filed = 0
+        else:
+            eng, _built = self._get_engine(job)
+            finds = self._shrink_finds(job, eng, ck)
+            job = self.store.transition(job.id, SHRUNK)
+            filed = self._file_finds(job, finds)
         self.store.transition(job.id, FILED, result={
             "report": report,
             "finds": finds,
@@ -439,11 +550,71 @@ class FleetWorker:
                 corpus.save(self.store.corpus_path, entries)
         return added
 
-    # -- failure -------------------------------------------------------------
+    # -- failure taxonomy ----------------------------------------------------
 
     def _fail(self, job: Job, reason: str) -> None:
+        """Deterministic refusal (fingerprint drift, contract
+        violation): retrying cannot change the outcome, so the job goes
+        straight to `failed` with the reason verbatim."""
         _LOG.error("job %s failed: %s", job.id, reason)
         print(f"job {job.id}: FAILED — {reason}", flush=True)
         job = self.store.get(job.id)
         if job.state in (QUEUED, COMPILING, RUNNING, FOUND, SHRUNK):
             self.store.transition(job.id, FAILED, error=reason)
+
+    @staticmethod
+    def _is_oom(exc: BaseException) -> bool:
+        return isinstance(exc, MemoryError) or any(
+            m in str(exc) for m in _OOM_MARKERS
+        )
+
+    def _hard_failure(self, job: Job, exc: BaseException) -> None:
+        """A worker-reported hard failure (engine raise, OOM) — the
+        retryable class, unlike `_fail`'s deterministic refusals.
+        OOM-class errors first get the lane-count backoff (halve lanes,
+        re-derive the warm-compile subkey, record the degradation);
+        everything else burns one poison attempt: requeue with
+        exponential backoff, quarantine at the cap with exception +
+        batch index + repro recorded."""
+        err = f"{type(exc).__name__}: {exc}"
+        _LOG.error("job %s unit failed: %s", job.id, err)
+        batch_index = self.store._ckpt_batch(job.id)
+        if self._is_oom(exc) and job.spec["batch"] > MIN_DEGRADED_BATCH:
+            out = self.store.degrade_lanes(
+                job.id, error=err, worker=self.worker_id
+            )
+            # the OOMing shape's engine may be the allocation itself —
+            # drop the live cache before the smaller shape compiles
+            self._engines.clear()
+            self._engine_subkey = None
+            print(
+                f"job {job.id}: OOM-class failure ({err}); degraded "
+                f"lanes {out.degraded[-1]['from_batch']} -> "
+                f"{out.spec['batch']} and requeued (subkey re-derived, "
+                f"checkpoint reset)", flush=True,
+            )
+            return
+        out = self.store.record_death(
+            job.id,
+            reason="worker hard failure",
+            worker=self.worker_id,
+            error=err,
+            batch_index=batch_index,
+            max_attempts=self.max_attempts,
+            backoff_base_s=self.backoff_base_s,
+        )
+        if out is None:
+            return  # raced a concurrent transition; nothing to record
+        if out.state == QUARANTINED:
+            print(
+                f"job {job.id}: QUARANTINED after {out.attempt} "
+                f"consecutive attempts — {err}\n"
+                f"  died at batch index {out.quarantine['batch_index']}; "
+                f"repro: {out.quarantine['repro']}", flush=True,
+            )
+        else:
+            print(
+                f"job {job.id}: attempt {out.attempt}/"
+                f"{self.max_attempts} failed ({err}); requeued with "
+                f"backoff", flush=True,
+            )

@@ -1,0 +1,121 @@
+"""Crash-safe atomic file writes — one discipline for every artifact.
+
+Every durable JSON artifact in this repo (hunt checkpoints, fleet job
+documents, StatsEmitter snapshots, the corpus, port files) historically
+grew its own copy of the same four lines: write `<path>.tmp`, rename
+over `<path>`. That is atomic against *process* death — `os.replace` is
+all-or-nothing — but it is NOT atomic against power loss or a kernel
+crash: the rename can be journaled while the tmp file's data blocks are
+still in the page cache, leaving a zero-length or torn file behind a
+rename that "succeeded". The full discipline, shared here so every
+call site means the same thing by "atomic", is::
+
+    write tmp -> flush -> fsync(tmp fd) -> rename -> fsync(directory)
+
+The directory fsync persists the rename itself (the directory entry is
+data too). `fsync=False` keeps the plain tmp+rename behavior for
+artifacts that are throwaway-on-crash (e.g. per-batch stats snapshots
+written many times a second).
+
+Chaos hook (the fleet-chaos harness's injection point): when
+``MADSIM_TPU_FLEET_CHAOS`` holds a JSON plan, writes whose absolute
+path contains the plan's ``match`` substring are counted, and the
+scheduled one dies deterministically:
+
+* ``{"kill_at_write": K}`` — SIGKILL this process *instead of* the K-th
+  write. Rename atomicity means the previous file version must survive.
+* ``{"torn_at_write": [K, B]}`` — the kill lands mid-write: B bytes of
+  the K-th payload reach the TMP file, the rename never runs, the
+  process dies. The claim "atomic" makes is exactly that the final
+  path still holds its previous version afterwards; `fleet fsck`
+  sweeps the stale tmp.
+
+The plan is parsed once per process (the harness sets the env var
+before spawning the victim); `_reset_chaos_for_tests` re-arms it.
+
+Pure stdlib, no jax, no wall-clock reads — safe to import from the
+jax-free fleet control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+from typing import Optional
+
+_CHAOS: Optional[dict] = None
+_WRITE_COUNT = 0
+
+
+def _chaos_plan() -> dict:
+    global _CHAOS
+    if _CHAOS is None:
+        raw = os.environ.get("MADSIM_TPU_FLEET_CHAOS")
+        _CHAOS = json.loads(raw) if raw else {}
+    return _CHAOS
+
+
+def _reset_chaos_for_tests() -> None:
+    global _CHAOS, _WRITE_COUNT
+    _CHAOS, _WRITE_COUNT = None, 0
+
+
+def _chaos_tick(path: str, text: str) -> None:
+    """Count this write against the armed plan; die if it is the
+    scheduled one. No-op (one dict read) when chaos is unarmed."""
+    plan = _chaos_plan()
+    if not plan:
+        return
+    match = plan.get("match")
+    if match and match not in os.path.abspath(path):
+        return
+    global _WRITE_COUNT
+    _WRITE_COUNT += 1
+    n = _WRITE_COUNT
+    if plan.get("kill_at_write") == n:
+        os.kill(os.getpid(), signal.SIGKILL)
+    torn = plan.get("torn_at_write")
+    if torn and int(torn[0]) == n:
+        with open(f"{path}.tmp", "w") as f:
+            f.write(text[: int(torn[1])])
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def fsync_dir(dirpath: str) -> None:
+    """Persist a just-performed rename in `dirpath`. Best-effort: some
+    filesystems refuse O_RDONLY directory fsync — that degrades back to
+    rename-without-dir-sync, never to an error on the write path."""
+    try:
+        fd = os.open(dirpath or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_text(path: str, text: str, *, fsync: bool = True) -> None:
+    """Atomically replace `path` with `text` (tmp + fsync + rename +
+    dir-fsync). A reader never observes a torn or partial file at
+    `path`; a crash at any instant leaves either the old version or the
+    new one."""
+    _chaos_tick(path, text)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if fsync:
+        fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def atomic_write_json(path: str, doc, *, indent: int = 1,
+                      sort_keys: bool = True, fsync: bool = True) -> None:
+    text = json.dumps(doc, indent=indent, sort_keys=sort_keys) + "\n"
+    atomic_write_text(path, text, fsync=fsync)

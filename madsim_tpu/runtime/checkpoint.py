@@ -24,6 +24,17 @@ from typing import Optional
 
 CKPT_VERSION = 1
 
+#: keys every complete checkpoint carries. The strict loader below
+#: only validates the version (a deliberate `--checkpoint PATH` should
+#: fail loudly on anything unexpected); the fleet's lenient reader and
+#: `fleet fsck` additionally treat a valid-JSON document missing any of
+#: these as corrupt — quarantine to `*.corrupt` and restart the stream
+#: — rather than letting a torn artifact crash the farm downstream.
+CKPT_REQUIRED_KEYS = frozenset({
+    "fingerprint", "batch", "planned", "cursor", "completed",
+    "seeds_consumed", "failing", "infra", "abandoned", "done",
+})
+
 # args fields that must match for a resume to be sound: anything that
 # changes which seeds run, in what order, or what they mean.
 _FINGERPRINT_FIELDS = (
@@ -50,19 +61,19 @@ def fingerprint_from_args(args) -> dict:
 
 
 def save_checkpoint(path: str, state: dict) -> None:
-    """Atomic write (tmp + rename): a kill mid-write leaves the previous
-    checkpoint intact, never a truncated JSON. Rides the host timeline
-    as a `checkpoint_write` span when a PerfRecorder is active —
-    per-batch persistence is part of the wall-clock budget."""
+    """Atomic write (the shared `runtime/atomicio` discipline: tmp +
+    fsync + rename + dir-fsync): a kill mid-write leaves the previous
+    checkpoint intact, never a truncated JSON — on a real filesystem,
+    not just against process death. Rides the host timeline as a
+    `checkpoint_write` span when a PerfRecorder is active — per-batch
+    persistence is part of the wall-clock budget."""
     from ..perf.recorder import maybe_span
 
+    from .atomicio import atomic_write_json
+
     doc = {"version": CKPT_VERSION, **state}
-    tmp = f"{path}.tmp"
     with maybe_span("checkpoint_write"):
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, path)
+        atomic_write_json(path, doc)
 
 
 def load_checkpoint(path: str) -> Optional[dict]:

@@ -151,12 +151,13 @@ class StatsEmitter:
         return out
 
     def _atomic_write(self, path: str, text: str) -> None:
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(text)
-        import os
+        # the shared rename discipline, WITHOUT the fsync half: these
+        # snapshots are rewritten every batch and are throwaway on
+        # crash — a scraper must never see a torn file, but losing the
+        # latest one to a power cut costs one poll interval
+        from .runtime.atomicio import atomic_write_text
 
-        os.replace(tmp, path)
+        atomic_write_text(path, text, fsync=False)
 
     def emit(self, record: dict) -> dict:
         """Emit one record (a plain dict of stats). Returns the record
