@@ -159,6 +159,11 @@ def _build_engine(args):
 def _build_engine_inner(args, Engine, EngineConfig, FaultPlan):
     machine = build_machine(args.machine, args.nodes)
     cfg = EngineConfig(
+        # guided hunts pin the 4-bit coverage band layout so the slot
+        # space stays identical across fault-vocabulary escalations
+        # (madsim_tpu/search); 0 keeps the derived layout — bit-for-bit
+        # the HEAD behavior — for every unguided run
+        cov_band_bits_min=4 if getattr(args, "guided", False) else 0,
         # round, not truncate: a shrunk repro prints horizon_us/1e6 and
         # float truncation would shave the failing event off the horizon
         horizon_us=round(args.horizon * 1e6),
@@ -357,6 +362,17 @@ def _stream_batches(eng, args, purpose="explore"):
     """
     import numpy as np
     import time as wall
+
+    if getattr(args, "guided", False):
+        # coverage-feedback search (madsim_tpu/search): same aggregate
+        # shape, same checkpoint file, same stats feed — but every
+        # batch's seed vector is chosen by the bias state instead of
+        # streamed sequentially. Guidance OFF never reaches this
+        # import, so the streaming path below stays byte-identical to
+        # HEAD by construction.
+        from .search.guided import run_guided
+
+        return run_guided(eng, args, purpose=purpose)
 
     log = logging.getLogger(f"madsim_tpu.{purpose}")
     emitter = _make_emitter(args)
@@ -790,6 +806,13 @@ def cmd_hunt(args) -> int:
     durable "open" regression entry with its minimized config."""
     from .engine import audit, corpus, shrink
 
+    if getattr(args, "guided", False):
+        if not args.stream:
+            sys.exit("--guided needs --stream (the chunked batch loop "
+                     "is where the feedback lives)")
+        if not getattr(args, "coverage", False):
+            sys.exit("--guided needs --coverage: the bias signal IS the "
+                     "live coverage map")
     eng = _build_engine(args)
     failing, infra, abandoned, agg = _find_failing(eng, args, purpose="hunt")
     stream_stats = agg.get("stats", {})
@@ -815,6 +838,17 @@ def cmd_hunt(args) -> int:
     _print_fr_stats(stream_stats)
     _print_cov_stats(stream_stats)
     _print_attribution(stream_stats)
+    guided_rec = agg.get("guided") or {}
+    if guided_rec:
+        g = stream_stats.get("guided", {})
+        print(
+            f"guided: escalation step {g.get('escalation', 0)}, "
+            f"{g.get('parents', 0)} corpus parents, "
+            f"{g.get('mutants', 0)} mutants over {g.get('batches', 0)} "
+            f"batches (trail recorded"
+            + (" in checkpoint)" if getattr(args, "checkpoint", None)
+               else ")")
+        )
     _write_coverage_out(eng, args, agg)
     entries = corpus.load(args.corpus)
     known = {e.key for e in entries}
@@ -837,13 +871,26 @@ def cmd_hunt(args) -> int:
                 else "beyond --limit, not shrunk"
             )
             print(f"  code {code}: {len(seeds_of)} seeds ({verb})")
+    esc_by_seed = {
+        int(k): int(v)
+        for k, v in (guided_rec.get("failing_escalation") or {}).items()
+    } if guided_rec else {}
     for seed, code in to_shrink:
+        # a guided find made under an escalated vocabulary only
+        # reproduces under that vocabulary: shrink (and the corpus
+        # entry's config) start from the escalation step's engine, and
+        # kind ablation then minimizes it honestly
+        shrink_eng = eng
+        if esc_by_seed.get(seed):
+            from .search.guided import engine_for_escalation
+
+            shrink_eng = engine_for_escalation(eng, esc_by_seed[seed])
         try:
             # the device-harvested provenance word (when the gate rode
             # the hunt) seeds the guided candidate order; shrink still
             # verifies every candidate by honest replay
             sr = shrink(
-                eng, seed, max_steps=args.max_steps,
+                shrink_eng, seed, max_steps=args.max_steps,
                 prov_word=agg.get("provenance", {}).get(seed),
             )
         except ValueError as exc:
@@ -1169,7 +1216,11 @@ def cmd_coverage(args) -> int:
     slots hit, per-band (event class / fault kind) marginals, the
     thinnest (band x model-phase) cells — the steer-here signal — and,
     with --diff, what a second run added over the first. Pure host-side
-    numpy: works without an accelerator stack."""
+    numpy: works without an accelerator stack. `--json` emits the same
+    tables machine-readably — the thinnest-cell list there is the
+    EXACT artifact the guided-search bias layer consumes
+    (runtime/coverage.top_uncovered), so operators and the bias state
+    read one truth."""
     from .runtime.coverage import load_coverage_doc, render_report
 
     try:
@@ -1177,6 +1228,29 @@ def cmd_coverage(args) -> int:
         diff_doc = load_coverage_doc(args.diff) if args.diff else None
     except (OSError, ValueError, KeyError) as exc:
         sys.exit(f"coverage: {exc}")
+    if getattr(args, "json", False):
+        from .runtime.coverage import (
+            coverage_dict, diff_maps, doc_band_bits, doc_maps, top_uncovered,
+        )
+
+        L = doc["slots_log2"]
+        bb = doc_band_bits(doc)
+        other = doc_maps(diff_doc) if diff_doc is not None else {}
+        out = {"slots_log2": L, "band_bits": bb, "maps": {}}
+        for name, m in doc_maps(doc).items():
+            entry = {
+                **coverage_dict(m, L, band_bits=bb),
+                "thinnest": top_uncovered(m, L, top=args.top, band_bits=bb),
+            }
+            if name in other:
+                dd = diff_maps(other[name], m)
+                entry["diff"] = {
+                    "new": dd["only_b"], "lost": dd["only_a"],
+                    "shared": dd["both"],
+                }
+            out["maps"][name] = entry
+        print(json.dumps(out, indent=1, sort_keys=True))
+        return 0
     print(render_report(doc, top=args.top, diff_doc=diff_doc))
     return 0
 
@@ -1436,6 +1510,7 @@ def cmd_fleet(args) -> int:
         if sub == "status":
             print(json.dumps(
                 client.status(addr, args.job, feed=args.feed,
+                              wait=getattr(args, "wait", 0) or 0,
                               retries=retries),
                 indent=1, sort_keys=True))
             return 0
@@ -1881,6 +1956,20 @@ def main(argv=None) -> int:
         help="shrink the first --limit failing seeds even when they share "
         "a fail code (default: one representative per distinct code)",
     )
+    p.add_argument(
+        "--guided", action="store_true",
+        help="coverage-feedback search (needs --stream --coverage): "
+        "every batch's seed vector is chosen — half mutated children "
+        "of seeds that hit new coverage slots (candidates scored by a "
+        "bias state fed from the live map's thin bands and, with "
+        "--provenance, the fault kinds in failure lineages), half "
+        "fresh sequential exploration; with --stop-on-plateau N a "
+        "plateau escalates the fault vocabulary along the recorded "
+        "ladder instead of stopping. The (seed schedule, bias state) "
+        "trail is recorded in the checkpoint and stats feed, so a "
+        "guided hunt resumes and replays byte-identically; guidance "
+        "off is bit-identical to the unguided streaming path",
+    )
     p.set_defaults(fn=cmd_hunt)
 
     p = sub.add_parser(
@@ -2000,6 +2089,13 @@ def main(argv=None) -> int:
     )
     p.add_argument("--top", type=int, default=8,
                    help="thinnest band x phase cells to list")
+    p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output: per-map slots/by-band summary "
+        "plus the thinnest-cell table (the same "
+        "runtime/coverage.top_uncovered artifact the guided-search "
+        "bias layer reads)",
+    )
     p.set_defaults(fn=cmd_coverage)
 
     p = sub.add_parser(
@@ -2185,6 +2281,15 @@ def main(argv=None) -> int:
     q.add_argument("--provenance", action="store_true")
     q.add_argument("--flight-recorder", action="store_true")
     q.add_argument("--stop-on-plateau", type=int, default=0)
+    q.add_argument(
+        "--guided", action="store_true",
+        help="coverage-feedback search (needs --coverage): the worker "
+        "evolves this job's seed corpus AFL-style, biases fault draws "
+        "toward thin coverage cells / implicated kinds, and escalates "
+        "the vocabulary on plateau; the (seed schedule, bias state) "
+        "trail rides the job checkpoint, so interrupt/resume and "
+        "worker replacement reproduce byte-identically",
+    )
     q.add_argument("--shrink-limit", type=int, default=5,
                    help="max distinct-code finds to shrink + file")
     q.add_argument("--priority", type=int, default=0,
@@ -2207,6 +2312,13 @@ def main(argv=None) -> int:
         if verb == "status":
             q.add_argument("--feed", type=int, default=20,
                            help="live-feed rows to include")
+            q.add_argument(
+                "--wait", type=float, default=0, metavar="S",
+                help="long-poll: the server holds the request up to S "
+                "seconds (capped server-side) and answers as soon as "
+                "the job document or its stats feed changes — clients "
+                "stop busy-polling GET /jobs/{id}",
+            )
         q.set_defaults(fn=cmd_fleet)
 
     q = fl.add_parser("queue", help="state counts + per-job summaries")

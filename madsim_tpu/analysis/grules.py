@@ -24,6 +24,12 @@ G007  engine/core.py K_* indices match FAULT_KIND_NAMES order, the
 G008  RNG-layout manifest audit (ops/rng_layout.manifest): the
       StepRngLayout section order is append-only — tail-only growth is
       the invariant that keeps every recorded stream byte-stable
+G009  guided-search escalation ladder (search/bias.py): every rung
+      must be DERIVED from kinds.FAULT_KIND_NAMES (slices /
+      concatenations of the bound table, never a literal mirror),
+      rungs must strictly widen, and the final rung must cover the
+      full CLI vocabulary — recorded guided trails name these rungs,
+      so a drifted ladder would silently re-key every recorded hunt
 
 All findings are repo-level (line 0 or the defining line) — inline
 suppressions don't apply; fix the drift or version the contract.
@@ -48,6 +54,7 @@ SHRINK_PY = "madsim_tpu/engine/shrink.py"
 MAIN_PY = "madsim_tpu/__main__.py"
 STEP_RNG_PY = "madsim_tpu/ops/step_rng.py"
 MANIFEST = "madsim_tpu/ops/rng_layout.manifest"
+SEARCH_BIAS_PY = "madsim_tpu/search/bias.py"
 GATES_TEST = "tests/test_step_gates.py"
 GOLDEN_TEST = "tests/test_golden_streams.py"
 
@@ -421,6 +428,9 @@ def check_repo(root: str) -> List[Finding]:
     # G008: RNG layout manifest
     findings.extend(_check_rng_layout(repo))
 
+    # G009: guided-search escalation ladder
+    findings.extend(_check_escalation_ladder(repo, kinds))
+
     return findings
 
 
@@ -531,6 +541,115 @@ def _check_core(
             f"FaultPlan.enabled_kinds() ladder {ladder} != the kinds "
             f"table order {want_ladder} — schedule derivation draws kinds "
             f"by this order",
+        ))
+    return findings
+
+
+def _check_escalation_ladder(
+    repo: _Repo, kinds: Dict[str, tuple]
+) -> List[Finding]:
+    """G009: `search/bias.py`'s ESCALATION_LADDER must be DERIVED from
+    the kinds tables (slices / `+`-concatenations of names bound from
+    madsim_tpu/kinds.py — a literal kind-name tuple here is exactly
+    the mirror class every other G-rule exists to refuse), each rung
+    must strictly widen the previous one, and the final rung must
+    cover the full CLI vocabulary."""
+    facts = repo.facts(SEARCH_BIAS_PY)
+    if facts is None:
+        return [_finding(
+            "G009", SEARCH_BIAS_PY,
+            f"{SEARCH_BIAS_PY} not found — the guided-search escalation "
+            f"ladder is a recorded contract and must stay auditable",
+        )]
+    node = facts.assigns.get("ESCALATION_LADDER")
+    if node is None or not isinstance(node, ast.Tuple):
+        return [_finding(
+            "G009", SEARCH_BIAS_PY,
+            "ESCALATION_LADDER must be a module-level tuple literal of "
+            "kinds-derived rungs (it is the recorded escalation "
+            "contract guided trails reference by step index)",
+        )]
+
+    used_binding = [False]
+
+    def resolve(expr: ast.expr) -> Optional[tuple]:
+        """Resolve a rung against the kinds tables: bound names,
+        constant-slice subscripts of bound names, literal tuples and
+        `+`-concatenations."""
+        if isinstance(expr, ast.Name):
+            bound = facts.binding_of(expr.id)
+            if bound is not None:
+                used_binding[0] = True
+                return kinds.get(bound[1])
+            return facts.resolve(expr.id)
+        if isinstance(expr, ast.Tuple):
+            out = []
+            for elt in expr.elts:
+                if not isinstance(elt, ast.Constant):
+                    return None
+                out.append(elt.value)
+            return tuple(out)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            left, right = resolve(expr.left), resolve(expr.right)
+            if left is None or right is None:
+                return None
+            return left + right
+        if isinstance(expr, ast.Subscript):
+            base = resolve(expr.value)
+            if base is None:
+                return None
+            sl = expr.slice
+            if isinstance(sl, ast.Slice):
+                lo = sl.lower.value if isinstance(sl.lower, ast.Constant) else None
+                hi = sl.upper.value if isinstance(sl.upper, ast.Constant) else None
+                if sl.step is None and (sl.lower is None or lo is not None) \
+                        and (sl.upper is None or hi is not None):
+                    return base[lo:hi]
+            return None
+        return None
+
+    rungs = [resolve(elt) for elt in node.elts]
+    if any(r is None for r in rungs) or not rungs:
+        return [_finding(
+            "G009", SEARCH_BIAS_PY,
+            "cannot statically resolve every ESCALATION_LADDER rung "
+            "from the kinds tables (rungs must be slices or "
+            "`+`-concatenations of names bound from madsim_tpu/kinds.py)",
+        )]
+    findings: List[Finding] = []
+    if not used_binding[0]:
+        findings.append(_finding(
+            "G009", SEARCH_BIAS_PY,
+            "ESCALATION_LADDER does not bind madsim_tpu/kinds.py — a "
+            "hand-maintained mirror of the kind vocabulary here is "
+            "exactly the drift class the kinds table exists to prevent",
+        ))
+    cli_names = set(n for n, _f in kinds["CLI_KIND_TO_FLAG"])
+    prev: set = set()
+    for i, rung in enumerate(rungs):
+        cur = set(rung)
+        if not cur <= cli_names:
+            findings.append(_finding(
+                "G009", SEARCH_BIAS_PY,
+                f"ESCALATION_LADDER rung {i} names unknown kinds "
+                f"{sorted(cur - cli_names)} (vocabulary: "
+                f"{sorted(cli_names)})",
+            ))
+        if not prev < cur:
+            findings.append(_finding(
+                "G009", SEARCH_BIAS_PY,
+                f"ESCALATION_LADDER rung {i} does not strictly widen "
+                f"rung {i - 1} — escalation must always ADD kinds "
+                f"(recorded trails reference rungs by index)",
+            ))
+        prev = cur
+    if prev != cli_names:
+        findings.append(_finding(
+            "G009", SEARCH_BIAS_PY,
+            f"ESCALATION_LADDER's final rung must cover the full CLI "
+            f"vocabulary {sorted(cli_names)}; got {sorted(prev)} — a "
+            f"kind the ladder never reaches is a scenario class no "
+            f"plateau can unlock",
         ))
     return findings
 

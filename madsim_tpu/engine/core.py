@@ -447,6 +447,16 @@ class EngineConfig:
     # result-identical — the map is write-only telemetry.
     coverage: bool = False
     cov_slots_log2: int = COV_SLOTS_LOG2_DEFAULT
+    # Coverage band-layout floor: 0 = derive from the fault vocabulary
+    # as always (3-bit legacy, 4-bit when a PR-5+ capability is on —
+    # every recorded map keeps its layout and golden slot constants).
+    # A guided hunt (madsim_tpu/search) pins 4 so the slot space stays
+    # IDENTICAL across fault-vocabulary escalations: cumulative maps,
+    # plateau deltas and parent detection must compare bits from every
+    # escalation step in one address space. Write-only telemetry
+    # layout, never result-affecting; excluded from corpus configs
+    # like the other coverage knobs.
+    cov_band_bits_min: int = 0
     # Causal provenance (observability): every queued event and every
     # node carries a 32-bit provenance word — one bit per scheduled
     # fault slot (bits 30/31: strict-restart wipes / duplicate
@@ -699,11 +709,17 @@ class Engine:
         # bits whenever any PR-5 chaos capability can occur (those are
         # new configs by definition, so every historical map keeps its
         # 3-bit layout and its golden slot constants).
-        self.cov_band_bits = (
+        if config.cov_band_bits_min not in (0, 3, 4):
+            raise ValueError(
+                f"cov_band_bits_min={config.cov_band_bits_min!r} — "
+                f"0 (derive), 3 or 4 are the known banded layouts"
+            )
+        self.cov_band_bits = max(
+            config.cov_band_bits_min,
             4
             if (fp.allow_pause or fp.allow_skew or fp.allow_dup
                 or fp.strict_restart or fp.allow_torn or fp.allow_heal_asym)
-            else 3
+            else 3,
         )
         min_log2 = self.cov_band_bits + 3 + 1
         if config.coverage and not min_log2 <= config.cov_slots_log2 <= 20:
@@ -2448,6 +2464,69 @@ class Engine:
             )
 
         return run
+
+    def run_seed_batch(self, seeds, max_steps: int = 10_000) -> dict:
+        """Run an EXPLICIT seed vector — one lane per seed, every lane
+        to completion, no streaming refill — and decode the result to
+        the `run_stream` dict shape. The guided-search batch runner
+        (madsim_tpu/search/guided.py): a guided batch is a *chosen* set
+        of seeds (corpus mutants + fresh exploration), which the
+        streaming executor's contiguous device-side seed counter cannot
+        express; `run_batch` takes any vector, so guidance rides the
+        fixed path and the streaming hot path stays byte-for-byte
+        untouched when guidance is off.
+
+        Returns {"completed", "failing": [(seed, code)...], "infra",
+        "abandoned": [seed...], "seeds_consumed", "stats": {}} plus,
+        under the coverage gate, "coverage_map" (bool[S] — the OR of
+        all lanes) and "cov_lane_words" (the per-lane packed int32 bit
+        maps, which is what parent detection diffs), and under the
+        provenance gate "provenance" {seed: violation word}."""
+        import numpy as np
+
+        seeds = jnp.asarray(np.asarray(list(seeds), dtype=np.uint32))
+        cache = self.__dict__.setdefault("_seed_batch_runners", {})
+        fn = cache.get(max_steps)
+        if fn is None:
+            fn = cache[max_steps] = self.make_runner(max_steps=max_steps)
+        res = fn(seeds)
+        seeds_np = np.asarray(res.seeds)
+        done = np.asarray(res.done)
+        failed = np.asarray(res.failed)
+        codes = np.asarray(res.fail_code)
+        failing, infra = [], []
+        for s, c in zip(seeds_np[failed].tolist(), codes[failed].tolist()):
+            (infra if int(c) == OVERFLOW else failing).append(
+                (int(s), int(c))
+            )
+        out = {
+            "completed": int(seeds_np.shape[0]),
+            "failing": failing,
+            "infra": infra,
+            # over the step budget without finishing: the fixed path's
+            # abandonment criterion, mirroring the streaming harvest
+            "abandoned": [int(s) for s in seeds_np[~done & ~failed]],
+            "seeds_consumed": int(seeds_np.shape[0]),
+            "stats": {},
+        }
+        if self.config.coverage:
+            from ..runtime.coverage import unpack_map
+
+            lane_words = np.asarray(res.cov["map"])
+            out["cov_lane_words"] = lane_words
+            out["coverage_map"] = unpack_map(
+                np.bitwise_or.reduce(lane_words, axis=0),
+                self.config.cov_slots_log2,
+            )
+        if self.config.provenance:
+            out["provenance"] = {
+                int(s): int(p)
+                for s, p in zip(
+                    seeds_np[failed].tolist(),
+                    np.asarray(res.fail_prov)[failed].tolist(),
+                )
+            }
+        return out
 
     def failing_seeds(self, result: BatchResult) -> jax.Array:
         """Gather the failing lane seeds back to the host

@@ -17,15 +17,22 @@ allocator is deliberately sticky:
   (priority is allowed to pay the compile switch; fairness inside a
   priority level is not);
 * which group starts first is decided by (priority desc, earliest
-  deadline, submit order) over each group's best job.
+  deadline, submit order) over each group's best job;
+* WITHIN the chosen group's equal-priority ring, the coverage-feedback
+  scheduler (`fleet/scheduler.py`) reallocates lane-time: jobs whose
+  live stats feed still shows new coverage slots (or that have no
+  signal yet) are served before stalled ones — lane-time goes where
+  bugs still hide, and a stalled job gets its lanes back the moment
+  the active set drains.
 
-Pure host-side policy over `Job` records — no jax, no IO; the worker
-owns all store writes. Unit-testable in microseconds.
+Pure host-side policy over `Job` records + the optional momentum map
+the worker reads for it — no jax, no IO here; the worker owns all
+store writes. Unit-testable in microseconds.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .store import Job
 
@@ -49,10 +56,14 @@ class LaneAllocator:
         self.current_subkey: Optional[str] = None
         self._last_served: dict = {}  # subkey -> job id
 
-    def pick(self, candidates: List[Job]) -> Optional[Job]:
+    def pick(self, candidates: List[Job],
+             momentum: Optional[Dict[str, dict]] = None) -> Optional[Job]:
         """Choose the job whose next batch-sized unit runs now, or None
         when there is nothing runnable. `candidates` are jobs the
-        worker can lease (non-terminal, lease available)."""
+        worker can lease (non-terminal, lease available); `momentum`
+        is the coverage-feedback map from `scheduler.momentum_for` —
+        when present, active jobs (still finding new slots / no signal
+        yet) outrank stalled ones within the equal-priority ring."""
         if not candidates:
             return None
         groups: dict = {}
@@ -74,7 +85,17 @@ class LaneAllocator:
         group = sorted(groups[target_sk], key=_job_rank)
         top_priority = group[0].priority
         ring = [j for j in group if j.priority == top_priority]
-        # round-robin within the equal-priority front of the group
+        if momentum is not None:
+            # lane-time goes where bugs still hide: serve the active
+            # front; stalled jobs wait until the actives drain
+            active = [
+                j for j in ring
+                if momentum.get(j.id, {}).get("active", True)
+            ]
+            if active:
+                ring = active
+        # round-robin within the (active front of the) equal-priority
+        # ring, so concurrent productive tenants interleave
         last = self._last_served.get(target_sk)
         ids = [j.id for j in ring]
         if last in ids and len(ids) > 1:

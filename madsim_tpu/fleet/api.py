@@ -8,7 +8,10 @@ read-only files, no sim/jax imports) to a read/write job API::
     GET    /jobs             = /queue
     GET    /queue            state counts + per-job summaries
     GET    /jobs/{id}        full job doc + live feed (?feed=N batch rows
-                             from the job's StatsEmitter JSONL)
+                             from the job's StatsEmitter JSONL; ?wait=S
+                             long-polls — the response is held until the
+                             job document or its feed changes, so
+                             watchers stop busy-polling)
     GET    /jobs/{id}/result find + shrunk repro + `why` attribution
                              (409 until the job reaches a terminal state)
     DELETE /jobs/{id}        cancel (queued dies now; running at the next
@@ -39,6 +42,7 @@ import logging
 import os
 import re
 import threading
+import time
 from typing import Optional, Tuple
 
 from . import httpd
@@ -70,6 +74,13 @@ def _job_summary(job) -> dict:
         "batches_run": job.progress.get("batches_run", 0),
         "batches_planned": job.progress.get("batches_planned"),
         "failing": job.progress.get("failing", 0),
+        # live search state (the scheduler's inputs, surfaced): the
+        # plateau verdict, the cumulative slots-hit count, and — for
+        # guided jobs — the current escalation rung
+        "plateau": bool(job.progress.get("plateau", False)),
+        "coverage_slots": job.progress.get("coverage_slots"),
+        "guided": bool(job.spec.get("guided", False)),
+        "escalation": job.progress.get("escalation"),
     }
 
 
@@ -144,14 +155,59 @@ class FleetAPI:
             "jobs": [_job_summary(j) for j in jobs],
         })
 
+    #: ?wait=S ceiling — a long-poll never parks a server thread
+    #: longer than this (clients re-issue; the stdlib server is
+    #: threading, so parked watchers don't block other requests)
+    WAIT_CAP_S = 30.0
+    #: change-detection poll cadence while a ?wait request is parked
+    WAIT_TICK_S = 0.2
+
+    def _state_token(self, job_id: str) -> tuple:
+        """A cheap change token for (job doc, stats feed): file sizes +
+        mtimes. Both artifacts are atomic-rename writes, so any state
+        change moves the token."""
+        token = []
+        for path in (self.store.job_path(job_id),
+                     self.store.stats_base(job_id) + ".jsonl"):
+            try:
+                st = os.stat(path)
+                token.append((st.st_mtime_ns, st.st_size))
+            except OSError:
+                token.append(None)
+        return tuple(token)
+
     def _status(self, job_id: str, query: str) -> Tuple[int, str, bytes]:
         job = self.store.get(job_id)
         feed_n = 20
         m = re.search(r"(?:^|&)feed=(\d+)", query)
         if m:
             feed_n = min(int(m.group(1)), 1000)
+        wait_s = 0.0
+        m = re.search(r"(?:^|&)wait=([0-9.]+)", query)
+        if m:
+            try:
+                wait_s = min(float(m.group(1)), self.WAIT_CAP_S)
+            except ValueError:
+                wait_s = 0.0
+        changed = None
+        if wait_s > 0 and not job.terminal:
+            # long-poll: park until the job document or its stats feed
+            # changes (atomic-rename artifacts — no torn observation),
+            # or the window elapses. Terminal jobs answer immediately:
+            # nothing will ever change again.
+            start_token = self._state_token(job_id)
+            deadline = time.monotonic() + wait_s  # madsim: allow(D001)
+            changed = False
+            while time.monotonic() < deadline:  # madsim: allow(D001)
+                time.sleep(self.WAIT_TICK_S)  # madsim: allow(D001)
+                if self._state_token(job_id) != start_token:
+                    changed = True
+                    break
+            job = self.store.get(job_id)  # freshest doc after the park
         doc = job.to_dict()
         doc["feed"] = self.store.read_feed(job_id, last=feed_n)
+        if changed is not None:
+            doc["wait"] = {"waited": True, "changed": changed}
         return _json(200, doc)
 
     def _result(self, job_id: str) -> Tuple[int, str, bytes]:

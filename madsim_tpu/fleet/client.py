@@ -130,9 +130,17 @@ def submit(addr: str, spec: dict, *, priority: int = 0,
     return out
 
 
-def status(addr: str, job_id: str, feed: int = 20,
+def status(addr: str, job_id: str, feed: int = 20, wait: float = 0,
            retries: int = DEFAULT_RETRIES) -> dict:
-    _, out = request(addr, "GET", f"/jobs/{job_id}?feed={feed}",
+    """Job doc + live feed. `wait > 0` long-polls: the server holds the
+    request until the job document or its stats feed changes (or the
+    window — capped server-side — elapses), so watchers make one
+    request per state change instead of busy-polling. The client
+    timeout stretches past the wait window."""
+    path = f"/jobs/{job_id}?feed={feed}"
+    if wait:
+        path += f"&wait={wait:g}"
+    _, out = request(addr, "GET", path, timeout=30.0 + float(wait),
                      retries=retries)
     return out
 

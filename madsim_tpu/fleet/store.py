@@ -136,6 +136,12 @@ SPEC_FIELDS = {
     "flight_recorder": (bool, False),
     "stop_on_plateau": (int, 0),
     "shrink_limit": (int, 5),
+    # coverage-feedback search (madsim_tpu/search): the worker evolves
+    # the job's seed corpus, biases draws toward thin coverage cells /
+    # lineage-implicated kinds, and escalates the vocabulary on
+    # plateau; the (seed schedule, bias state) trail rides the job
+    # checkpoint so resume/replacement replays are byte-identical
+    "guided": (bool, False),
 }
 
 SEGMENT_STEPS = 384  # the streaming driver's pinned segment shape
@@ -174,6 +180,10 @@ def normalize_spec(spec: dict) -> dict:
         raise ValueError(
             "stop_on_plateau needs coverage: the plateau signal IS the "
             "coverage curve"
+        )
+    if out["guided"] and not out["coverage"]:
+        raise ValueError(
+            "guided needs coverage: the bias signal IS the live map"
         )
     return out
 
@@ -244,8 +254,15 @@ def repro_cmd(spec: dict, *, batch_index: Optional[int] = None) -> str:
     with `batch_index`, the single batch it died in (batch i always
     consumes the same seed range, so one batch is a complete repro).
     Recorded verbatim in quarantine documents: a poisoned job must be
-    debuggable from its doc alone, with no farm running."""
+    debuggable from its doc alone, with no farm running.
+
+    Guided jobs cannot be sliced to one batch (their batch seed
+    vectors are bias-chosen, not sequential ranges) — the full-run
+    command reproduces the identical schedule deterministically, so
+    that is the honest repro."""
     start, seeds = spec["seed"], spec["seeds"]
+    if spec.get("guided"):
+        batch_index = None
     if batch_index is not None:
         start = spec["seed"] + batch_index * spec["batch"]
         seeds = max(1, min(spec["batch"], spec["seeds"] - batch_index * spec["batch"]))
@@ -262,7 +279,8 @@ def repro_cmd(spec: dict, *, batch_index: Optional[int] = None) -> str:
     for flag, key in (("--strict-restart", "strict_restart"),
                       ("--coverage", "coverage"),
                       ("--provenance", "provenance"),
-                      ("--flight-recorder", "flight_recorder")):
+                      ("--flight-recorder", "flight_recorder"),
+                      ("--guided", "guided")):
         if spec.get(key):
             parts.append(flag)
     return " ".join(parts)

@@ -178,7 +178,12 @@ class FleetWorker:
                     and lease["expires_ts"] > now):
                 continue  # someone else is (still) on it
             cands.append(j)
-        picked = self.alloc.pick(cands)
+        # coverage-feedback reallocation: one momentum read per
+        # candidate (its stats feed tail + progress mirror), so the
+        # allocator serves jobs still finding new slots first
+        from .scheduler import momentum_for
+
+        picked = self.alloc.pick(cands, momentum=momentum_for(self.store, cands))
         if picked is None:
             return None
         return self.store.try_lease(picked.id, self.worker_id, self.lease_ttl_s)
@@ -315,7 +320,7 @@ class FleetWorker:
             return {"batches_run": 0, "batches_planned": None,
                     "completed": 0, "seeds_consumed": 0, "failing": 0,
                     "infra": 0, "abandoned": 0, "plateau": False,
-                    "coverage_slots": None}
+                    "coverage_slots": None, "escalation": None}
         cov_slots = None
         if eng is not None and ck.get("cov_b64"):
             from ..runtime.coverage import decode_map
@@ -323,6 +328,7 @@ class FleetWorker:
             cov_slots = int(
                 decode_map(ck["cov_b64"], eng.config.cov_slots_log2).sum()
             )
+        guided = ck.get("guided") or {}
         return {
             "batches_run": int(ck["batch"]),
             "batches_planned": int(ck["planned"]),
@@ -333,6 +339,10 @@ class FleetWorker:
             "abandoned": len(ck["abandoned"]),
             "plateau": bool(ck.get("plateau", False)),
             "coverage_slots": cov_slots,
+            # guided search state mirror (None for unguided jobs): the
+            # escalation rung feeds `fleet status`/`queue` and the
+            # scheduler's momentum read
+            "escalation": (guided.get("bias") or {}).get("escalation"),
         }
 
     # -- finalization --------------------------------------------------------
@@ -358,7 +368,7 @@ class FleetWorker:
                     "completed": 0, "seeds_consumed": 0, "failing": [],
                     "infra": [], "abandoned": 0, "plateau": False,
                     "coverage_slots": None, "stop_reason": stop_reason}
-        return {
+        report = {
             "batches_run": int(ck["batch"]),
             "batches_planned": int(ck["planned"]),
             "completed": int(ck["completed"]),
@@ -370,6 +380,17 @@ class FleetWorker:
             "coverage_slots": None,
             "stop_reason": stop_reason,
         }
+        if ck.get("guided"):
+            # the (seed schedule, bias state) record rides the result:
+            # a guided job is replayable from its result doc alone —
+            # same contract as the checkpoint, surfaced to clients
+            g = ck["guided"]
+            report["guided"] = {
+                "bias": g.get("bias"),
+                "escalation": (g.get("bias") or {}).get("escalation"),
+                "trail": g.get("trail", []),
+            }
+        return report
 
     def _finalize(self, job: Job, stop_reason: Optional[str] = None) -> None:
         ck = self._load_ckpt(job)
@@ -447,6 +468,11 @@ class FleetWorker:
 
         spec = job.spec
         prov = {int(k): int(v) for k, v in (ck.get("prov") or {}).items()}
+        esc_by_seed = {
+            int(k): int(v)
+            for k, v in ((ck.get("guided") or {})
+                         .get("failing_escalation") or {}).items()
+        }
         by_code: dict = {}
         for seed, code in ck["failing"]:
             by_code.setdefault(int(code), []).append(int(seed))
@@ -455,9 +481,18 @@ class FleetWorker:
         finds: List[dict] = []
         for seed, code in reps:
             doc: dict = {"seed": seed, "code": code}
+            # a guided find made under an escalated vocabulary only
+            # reproduces under that vocabulary — shrink (and the filed
+            # entry's config) start from the escalation step's engine
+            shrink_eng = eng
+            if esc_by_seed.get(seed):
+                from ..search.guided import engine_for_escalation
+
+                shrink_eng = engine_for_escalation(eng, esc_by_seed[seed])
+                doc["escalation"] = esc_by_seed[seed]
             try:
                 sr = shrink_mod.shrink(
-                    eng, seed, max_steps=spec["max_steps"],
+                    shrink_eng, seed, max_steps=spec["max_steps"],
                     prov_word=prov.get(seed),
                 )
             except ValueError as exc:
@@ -485,7 +520,7 @@ class FleetWorker:
             if seed in prov:
                 from ..engine.provenance import implicated
 
-                att = implicated(eng, seed, prov[seed])
+                att = implicated(shrink_eng, seed, prov[seed])
                 doc["why"] = {
                     "prov_word": prov[seed],
                     "kinds": list(att.kinds),

@@ -14,6 +14,13 @@ The checkpoint carries a FINGERPRINT of every argument that shapes the
 seed schedule or the failure semantics; resuming with a mismatched
 command line is refused rather than silently blending two different
 hunts. Pure host-side JSON — no jax import.
+
+Guided hunts (`--guided`, madsim_tpu/search) extend the document with
+a "guided" record — the bias state, seed corpus, per-batch (seed
+schedule, bias state) trail and per-find escalation steps — which is
+the COMPLETE remaining-schedule state: a resumed (or
+replacement-worker) guided hunt recomputes the identical seed
+schedule from it, asserted byte-identical in tests/test_search.py.
 """
 
 from __future__ import annotations
@@ -53,6 +60,10 @@ _FINGERPRINT_FIELDS = (
     "strict_restart",
     "coverage",
     "stop_on_plateau",
+    # guided mode reshapes the whole seed schedule (corpus mutants +
+    # bias-selected batches): resuming a guided checkpoint without
+    # --guided (or vice versa) would blend two different hunts
+    "guided",
 )
 
 

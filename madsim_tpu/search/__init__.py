@@ -1,0 +1,24 @@
+"""madsim_tpu/search — coverage-feedback guided hunting.
+
+The subsystem that finally *acts* on the observability the engine
+pays for: `bias.py` turns the live coverage map's per-band marginals
+and harvested failure-lineage words into per-kind draw weights (plus
+the recorded fault-vocabulary escalation ladder), `mutate.py` derives
+deterministic child seeds for the AFL-style corpus, `features.py`
+re-derives candidate schedules host-side for scoring, and `guided.py`
+runs the `--guided` batch loop with exact (seed schedule, bias state)
+recording — checkpoint/resume and fleet worker replacement reproduce
+byte-identically, and guidance-off leaves every HEAD code path
+untouched.
+
+`bias` and `mutate` are jax-free (the fleet control plane reads
+recorded bias trails); `features`/`guided` touch jax only when called.
+"""
+
+from .bias import (  # noqa: F401
+    ESCALATION_LADDER,
+    BiasState,
+    next_escalation,
+    vocabulary_for,
+)
+from .mutate import child_seed, children, mix32  # noqa: F401

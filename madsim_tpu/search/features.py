@@ -1,0 +1,86 @@
+"""Candidate-seed schedule features — the host-side peek at what a
+seed WOULD do.
+
+The determinism contract makes guided selection cheap: a lane's fault
+schedule is a pure function of (seed, FaultPlan), derived by the same
+`init_lane` code the device executes. So the bias layer can score a
+whole candidate pool without running a single simulation — one vmapped
+jitted slice of `init_lane` over the candidate seed vector returns
+every candidate's drawn (kind, apply-time, target) triples, bit-equal
+to what those seeds would run (the same derivation
+`engine/provenance.py` uses to decode lineage words, vectorized).
+
+Cached on the machine object like the provenance/compiled-replay
+caches: guided hunts build several escalated Engines over one machine,
+and each (FaultPlan, queue, stream-version) pairing compiles its
+feature slice once.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+
+def _feats_fn(engine):
+    import jax
+
+    cache = engine.machine.__dict__.setdefault("_search_feats_cache", {})
+    key = (engine.config.faults, engine.config.queue_capacity,
+           engine.config.rng_stream)
+    if key not in cache:
+        n = engine.machine.NUM_NODES
+        fp = engine.config.faults
+        lo, hi = n, n + fp.slots_per_fault * fp.n_faults
+
+        def feats(seeds):
+            def one(seed):
+                s = engine.init_lane(seed)
+                return (
+                    s.eq_time[lo:hi], s.eq_payload[lo:hi, 0],
+                    s.eq_payload[lo:hi, 1],
+                )
+
+            return jax.vmap(one)(seeds)
+
+        cache[key] = jax.jit(feats)
+    return cache[key]
+
+
+def schedule_features(engine, seeds: Sequence[int]) -> Dict[str, np.ndarray]:
+    """Per-seed fault-schedule features for a seed vector: int arrays
+    of shape [len(seeds), n_faults] — "kinds" (K_* indices), "t_apply"
+    (virtual us), "targets" (payload arg1: the node / pair-a / mask-lo
+    the fault lands on). Empty [n, 0] arrays when the plan schedules
+    no faults (guidance then has nothing to score — selection falls
+    back to the first candidate)."""
+    import jax.numpy as jnp
+
+    fp = engine.config.faults
+    n_seeds = len(seeds)
+    if fp.n_faults == 0 or n_seeds == 0:
+        empty = np.zeros((n_seeds, 0), np.int32)
+        return {"kinds": empty, "t_apply": empty, "targets": empty}
+    times, ops, args1 = _feats_fn(engine)(
+        jnp.asarray(list(seeds), jnp.uint32)
+    )
+    times, ops, args1 = (np.asarray(x) for x in (times, ops, args1))
+    spf = fp.slots_per_fault
+    apply_slots = np.arange(fp.n_faults) * spf
+    return {
+        # the apply slot's op encodes the kind: op = 2*kind (+1 = undo)
+        "kinds": (ops[:, apply_slots] // 2).astype(np.int32),
+        "t_apply": times[:, apply_slots].astype(np.int32),
+        "targets": args1[:, apply_slots].astype(np.int32),
+    }
+
+
+def kind_name_rows(engine, kinds: np.ndarray) -> list:
+    """Map a [n, F] kind-index array to per-seed kind-name tuples (the
+    shape `BiasState.score_kinds` consumes)."""
+    from ..engine.core import FAULT_KIND_NAMES
+
+    return [
+        tuple(FAULT_KIND_NAMES[int(k)] for k in row) for row in kinds
+    ]

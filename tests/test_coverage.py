@@ -185,6 +185,35 @@ def test_cli_coverage_report(tmp_path, capsys):
         assert name in out
 
 
+def test_cli_coverage_json_matches_renderer_inputs(tmp_path, capsys):
+    """`coverage DOC --json` must emit the EXACT thinnest-cell table
+    the renderer computes (runtime/coverage.top_uncovered) — the bias
+    layer and operators read one artifact, not two."""
+    import json as _json
+
+    from madsim_tpu.__main__ import main
+    from madsim_tpu.runtime.coverage import coverage_dict, top_uncovered
+
+    rng = np.random.default_rng(3)
+    m = rng.random(1 << 10) < 0.05
+    base = rng.random(1 << 10) < 0.02
+    path = str(tmp_path / "cov.json")
+    old = str(tmp_path / "old.json")
+    save_coverage_doc(path, make_coverage_doc({"etcd": m}, 10))
+    save_coverage_doc(old, make_coverage_doc({"etcd": base}, 10))
+    assert main(["coverage", path, "--top", "4", "--json",
+                 "--diff", old]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["slots_log2"] == 10 and doc["band_bits"] == 3
+    entry = doc["maps"]["etcd"]
+    assert entry["slots_hit"] == coverage_dict(m, 10)["slots_hit"]
+    assert entry["thinnest"] == top_uncovered(m, 10, top=4)
+    d = diff_maps(base, m)
+    assert entry["diff"] == {
+        "new": d["only_b"], "lost": d["only_a"], "shared": d["both"],
+    }
+
+
 def test_stop_on_plateau_cli_end_to_end(tmp_path, capsys):
     """A fault-free echo config saturates its scenario space almost
     immediately: `explore --stream --coverage --stop-on-plateau` must

@@ -74,7 +74,7 @@ def test_spec_fingerprint_matches_hunt_checkpoint_fingerprint():
         machine="echo", nodes=0, seed=0, seeds=96, batch=32, max_steps=300,
         horizon=1.0, loss=0.0, faults=0, fault_tmax=0,
         fault_kinds="pair,kill", rng_stream=2, strict_restart=False,
-        coverage=False, stop_on_plateau=0,
+        coverage=False, stop_on_plateau=0, guided=False,
     )
     assert job_fingerprint(spec) == fingerprint_from_args(cli_args)
     # and the namespace the worker hands to the streaming driver carries
@@ -219,6 +219,139 @@ def test_allocator_deadline_orders_within_priority():
     late = _mk_job(1, "s1", deadline_ts=1e12)
     al = LaneAllocator()
     assert al.pick([late, soon]).id == soon.id
+
+
+# -- coverage-feedback scheduler ---------------------------------------------
+
+
+def test_spec_guided_needs_coverage():
+    with pytest.raises(ValueError, match="guided needs coverage"):
+        normalize_spec({"machine": "raft", "guided": True})
+    spec = normalize_spec({"machine": "raft", "guided": True,
+                           "coverage": True})
+    assert spec["guided"] is True
+    # guided is a fingerprint field: flipping it refuses a resume
+    other = dict(spec)
+    other["guided"] = False
+    assert job_fingerprint(spec) != job_fingerprint(other)
+
+
+def test_scheduler_momentum_reads_feed_and_progress(tmp_path):
+    from madsim_tpu.fleet.scheduler import job_momentum, momentum_for
+
+    st = JobStore(str(tmp_path))
+    hot = st.submit(dict(ECHO_SPEC))
+    cold = st.submit(dict(ECHO_SPEC))
+    fresh = st.submit(dict(ECHO_SPEC))
+
+    def feed(job_id, new_slots_list):
+        with open(st.stats_base(job_id) + ".jsonl", "w") as f:
+            for i, n in enumerate(new_slots_list):
+                f.write(json.dumps({
+                    "kind": "fleet_batch", "batch": i,
+                    "coverage": {"slots_hit": 100 + i, "new_slots": n},
+                }) + "\n")
+
+    feed(hot.id, [40, 3, 2])
+    # only the last RECENT_BATCHES rows count: an old burst ages out
+    feed(cold.id, [40, 0, 0, 0, 0, 0])
+    m_hot = job_momentum(st, st.get(hot.id))
+    m_cold = job_momentum(st, st.get(cold.id))
+    m_fresh = job_momentum(st, st.get(fresh.id))
+    assert m_hot["active"] and m_hot["new_slots_recent"] == 45
+    assert not m_cold["active"] and m_cold["new_slots_recent"] == 0
+    assert m_fresh["active"] and m_fresh["batches_seen"] == 0  # bootstrap
+    # a plateaued job is never active, whatever its feed says
+    st.note_progress(hot.id, "w0", {"plateau": True})
+    assert not job_momentum(st, st.get(hot.id))["active"]
+    # jobs that emit no coverage at all keep their lanes (no signal is
+    # not a verdict)
+    blind = st.submit(dict(ECHO_SPEC))
+    with open(st.stats_base(blind.id) + ".jsonl", "w") as f:
+        f.write(json.dumps({"kind": "fleet_batch", "batch": 0}) + "\n")
+    assert job_momentum(st, st.get(blind.id))["active"]
+    m = momentum_for(st, st.list())
+    assert set(m) == {hot.id, cold.id, fresh.id, blind.id}
+
+
+def test_allocator_momentum_reallocates_within_ring():
+    a, b, c = _mk_job(1, "s1"), _mk_job(2, "s1"), _mk_job(3, "s1")
+    al = LaneAllocator()
+    mom = {
+        a.id: {"active": True}, b.id: {"active": False},
+        c.id: {"active": True},
+    }
+    # the active front (a, c) round-robins; the stalled job waits
+    picks = [al.pick([a, b, c], momentum=mom).id for _ in range(4)]
+    assert picks == [a.id, c.id, a.id, c.id]
+    # the stalled job gets its lanes back the moment the actives drain
+    assert al.pick([b], momentum=mom).id == b.id
+    # an all-stalled ring still runs (budget completion over starvation)
+    mom_all = {j.id: {"active": False} for j in (a, b, c)}
+    assert al.pick([a, b, c], momentum=mom_all) is not None
+    # jobs missing from the momentum map default to active
+    assert al.pick([a, b], momentum={}).id in (a.id, b.id)
+
+
+def test_api_status_wait_longpoll(tmp_path):
+    """?wait=S holds the GET until the job's artifacts change (or the
+    window ends) — the streaming-results item in its minimal honest
+    form. Terminal jobs answer immediately."""
+    import threading
+
+    st = JobStore(str(tmp_path))
+    api = FleetAPI(st)
+    api.WAIT_TICK_S = 0.05
+    job = st.submit(dict(ECHO_SPEC))
+
+    # no change: returns after the window with changed=False
+    t0 = time.monotonic()
+    status, _, body = api.handle("GET", f"/jobs/{job.id}?feed=2&wait=0.2")
+    doc = json.loads(body)
+    assert status == 200
+    assert doc["wait"] == {"waited": True, "changed": False}
+    assert time.monotonic() - t0 >= 0.2
+
+    # a stats-feed append mid-wait releases the poll promptly
+    def touch():
+        with open(st.stats_base(job.id) + ".jsonl", "a") as f:
+            f.write(json.dumps({"kind": "fleet_batch", "batch": 0}) + "\n")
+
+    timer = threading.Timer(0.15, touch)
+    timer.start()
+    t0 = time.monotonic()
+    status, _, body = api.handle("GET", f"/jobs/{job.id}?wait=10")
+    timer.join()
+    doc = json.loads(body)
+    assert doc["wait"] == {"waited": True, "changed": True}
+    assert time.monotonic() - t0 < 5  # released by the change, not the cap
+    assert [r["batch"] for r in doc["feed"]] == [0]
+
+    # terminal jobs never park: nothing will change again
+    st.transition(job.id, COMPILING)
+    st.transition(job.id, RUNNING)
+    st.transition(job.id, EXHAUSTED, result={"report": {}, "finds": []})
+    t0 = time.monotonic()
+    status, _, body = api.handle("GET", f"/jobs/{job.id}?wait=5")
+    assert time.monotonic() - t0 < 1
+    assert "wait" not in json.loads(body)
+
+
+def test_queue_summaries_surface_search_state(tmp_path):
+    st = JobStore(str(tmp_path))
+    api = FleetAPI(st)
+    spec = dict(ECHO_SPEC)
+    spec.update(coverage=True, guided=True)
+    job = st.submit(spec)
+    st.note_progress(job.id, "w0", {
+        "plateau": False, "coverage_slots": 321, "escalation": 2,
+    })
+    _, _, body = api.handle("GET", "/queue")
+    summary = [j for j in json.loads(body)["jobs"] if j["id"] == job.id][0]
+    assert summary["guided"] is True
+    assert summary["coverage_slots"] == 321
+    assert summary["escalation"] == 2
+    assert summary["plateau"] is False
 
 
 # -- control-plane API -------------------------------------------------------

@@ -341,6 +341,7 @@ _G_FILES = (
     "madsim_tpu/ops/coverage.py",
     "madsim_tpu/ops/step_rng.py",
     "madsim_tpu/ops/rng_layout.manifest",
+    "madsim_tpu/search/bias.py",
     "tests/test_step_gates.py",
     "tests/test_golden_streams.py",
 )
@@ -468,6 +469,53 @@ def test_g008_rng_layout_manifest(repo_copy):
     found = grules.check_repo(str(repo_copy))
     assert [f.rule for f in found] == ["G008"]
     assert "inserted, removed or reordered" in found[0].message
+
+
+def test_g009_escalation_ladder_literal_mirror(repo_copy):
+    """A hand-maintained kind-name literal in the escalation ladder is
+    exactly the drift class the kinds table exists to prevent."""
+    _mutate(
+        repo_copy, "madsim_tpu/search/bias.py",
+        "ESCALATION_LADDER = (\n"
+        "    FAULT_KIND_NAMES[:6],\n"
+        "    FAULT_KIND_NAMES[:8],\n"
+        "    FAULT_KIND_NAMES[:10],\n"
+        "    FAULT_KIND_NAMES + (\"dup\",),\n"
+        ")",
+        "ESCALATION_LADDER = (\n"
+        '    ("pair", "kill", "dir", "group", "storm", "delay"),\n'
+        '    ("pair", "kill", "dir", "group", "storm", "delay",\n'
+        '     "pause", "skew"),\n'
+        '    ("pair", "kill", "dir", "group", "storm", "delay",\n'
+        '     "pause", "skew", "torn", "heal-asym"),\n'
+        '    ("pair", "kill", "dir", "group", "storm", "delay",\n'
+        '     "pause", "skew", "torn", "heal-asym", "dup"),\n'
+        ")",
+    )
+    found = grules.check_repo(str(repo_copy))
+    assert [f.rule for f in found] == ["G009"]
+    assert "bind" in found[0].message
+
+
+def test_g009_ladder_must_widen_and_cover(repo_copy):
+    # a rung that narrows (slice shrinks) breaks strict widening
+    _mutate(
+        repo_copy, "madsim_tpu/search/bias.py",
+        "FAULT_KIND_NAMES[:8],", "FAULT_KIND_NAMES[:4],",
+    )
+    found = grules.check_repo(str(repo_copy))
+    assert "G009" in {f.rule for f in found}
+    assert any("widen" in f.message for f in found)
+
+
+def test_g009_ladder_final_rung_must_cover_palette(repo_copy):
+    _mutate(
+        repo_copy, "madsim_tpu/search/bias.py",
+        'FAULT_KIND_NAMES + ("dup",),\n', "FAULT_KIND_NAMES,\n",
+    )
+    found = grules.check_repo(str(repo_copy))
+    assert "G009" in {f.rule for f in found}
+    assert any("full CLI" in f.message for f in found)
 
 
 def test_lint_cli_catches_injected_drift(repo_copy, capsys):

@@ -1,0 +1,503 @@
+"""Guided-search subsystem tests (madsim_tpu/search).
+
+Four layers, mirroring the package:
+
+ 1. the deterministic mutator — pinned avalanche constants (changing
+    them re-keys every recorded guided seed schedule, so they are
+    golden);
+ 2. the bias state — hand-computed weight-update fixtures, exact
+    persistence round-trips, the escalation ladder semantics;
+ 3. selection — `_select_batch` is a pure function of its arguments
+    (stubbed features, no jax);
+ 4. the guided loop against a real engine — schedule features match
+    the provenance derivation bit-for-bit, a checkpointed guided hunt
+    resumes to a byte-identical (seed schedule, bias state) trail, and
+    a cell-grid plateau escalates the vocabulary.
+
+The engine half shares one module-scoped tiny raft engine; the
+run_seed_batch-vs-run_stream agreement check costs a streaming compile
+and lives in the slow tier with the fleet worker-replacement replay.
+"""
+
+import dataclasses
+import json
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from madsim_tpu.kinds import CLI_KIND_TO_FLAG, FAULT_KIND_NAMES
+from madsim_tpu.search import bias as bias_mod
+from madsim_tpu.search import mutate
+from madsim_tpu.search.bias import (
+    ESCALATION_LADDER,
+    BiasState,
+    band_fractions_from_coverage,
+    next_escalation,
+    vocabulary_for,
+)
+
+CLI_NAMES = tuple(n for n, _f in CLI_KIND_TO_FLAG)
+
+
+# -- mutator: pinned avalanche constants --------------------------------------
+
+
+def test_mix32_pinned_constants():
+    """Golden: these values key every recorded guided seed schedule."""
+    assert mutate.mix32(0, 0) == 2462723854
+    assert mutate.mix32(1, 0) == 2527132011
+    assert mutate.mix32(1234, 0) == 1889054206
+    assert mutate.mix32(0xFFFFFFFF, 7) == 1650816001
+
+
+def test_child_seed_pinned_and_nonzero():
+    assert mutate.child_seed(42, 0, 1, 0, 0) == 2911220862
+    assert mutate.child_seed(42, 1, 1, 0, 0) == 3470864384
+    assert mutate.child_seed(42, 2, 3, 5, 1) == 3353176113
+    # the full coordinate tuple matters: op / batch / slot / candidate
+    # all fork the stream
+    base = mutate.child_seed(7, 0, 1, 0, 0)
+    assert mutate.child_seed(7, 1, 1, 0, 0) != base
+    assert mutate.child_seed(7, 0, 2, 0, 0) != base
+    assert mutate.child_seed(7, 0, 1, 1, 0) != base
+    assert mutate.child_seed(7, 0, 1, 0, 1) != base
+    # never 0 (the sequential-scan origin), always uint32
+    for p in (0, 1, 42, 0xFFFFFFFF):
+        for op in (0, 1, 2):
+            s = mutate.child_seed(p, op, 0, 0, 0)
+            assert 1 <= s <= 0xFFFFFFFF
+
+
+def test_children_deterministic_operator_major():
+    got = mutate.children(42, 2, 1)
+    assert got == [(0, 3815504888), (1, 3647677267), (2, 58310815)]
+    assert got == mutate.children(42, 2, 1)  # pure
+
+
+def test_classify_child_labels():
+    p = {"kinds": [0, 1], "t_apply": [10, 20], "targets": [1, 2]}
+    assert mutate.classify_child(p, {**p, "kinds": [1, 1]}) == "kind-flip"
+    assert mutate.classify_child(p, {**p, "t_apply": [11, 20]}) == "delay-nudge"
+    assert mutate.classify_child(p, {**p, "targets": [2, 2]}) == "target-rotate"
+    assert mutate.classify_child(p, dict(p)) == "target-rotate"
+
+
+# -- escalation ladder --------------------------------------------------------
+
+
+def test_ladder_binds_kinds_and_widens():
+    assert ESCALATION_LADDER[0] == FAULT_KIND_NAMES[:6]
+    assert ESCALATION_LADDER[1] == FAULT_KIND_NAMES[:8]
+    assert ESCALATION_LADDER[2] == FAULT_KIND_NAMES[:10]
+    assert ESCALATION_LADDER[3] == FAULT_KIND_NAMES + ("dup",)
+    prev = set()
+    for rung in ESCALATION_LADDER:
+        assert prev < set(rung)
+        prev = set(rung)
+    assert prev == set(CLI_NAMES)
+
+
+def test_vocabulary_for_steps():
+    assert vocabulary_for(("pair", "kill"), 0) == ("pair", "kill")
+    assert vocabulary_for(("pair", "kill"), 1) == (
+        "pair", "kill", "dir", "group", "storm", "delay"
+    )
+    # the base vocabulary is always unioned in, and output follows the
+    # CLI print order (dup between skew and torn)
+    assert vocabulary_for(("torn",), 1) == (
+        "pair", "kill", "dir", "group", "storm", "delay", "torn"
+    )
+    assert vocabulary_for(("pair",), 4) == CLI_NAMES
+    with pytest.raises(ValueError):
+        vocabulary_for(("pair",), 5)
+
+
+def test_next_escalation_skips_nonwidening_rungs():
+    assert next_escalation(("pair", "kill"), 0) == 1
+    # a base already covering rung 1 skips straight to rung 2
+    assert next_escalation(FAULT_KIND_NAMES[:6], 0) == 2
+    # the full palette has nowhere to go
+    assert next_escalation(CLI_NAMES, 0) is None
+    assert next_escalation(("pair", "kill"), 4) is None
+
+
+# -- bias state ---------------------------------------------------------------
+
+
+def test_bias_fresh_uniform_and_dup_excluded():
+    b = BiasState.fresh(("pair", "kill", "dup"))
+    assert b.weights == {"pair": 0.5, "kill": 0.5}  # dup: not scheduled
+    assert b.escalation == 0 and b.updates == 0
+
+
+def test_bias_update_hand_computed():
+    """The exact arithmetic, by hand: raw_k = (1 + prov_k) *
+    (1 + (1 - frac_k)); weights = raw / sum(raw)."""
+    b = BiasState.fresh(("pair", "kill"))
+    b.update({"pair": 0.5, "kill": 0.0}, {"kill": 3})
+    raw_pair = (1.0 + 0) * (1.0 + (1.0 - 0.5))   # 1.5
+    raw_kill = (1.0 + 3) * (1.0 + (1.0 - 0.0))   # 8.0
+    total = raw_pair + raw_kill
+    assert b.weights == {"pair": raw_pair / total, "kill": raw_kill / total}
+    assert b.updates == 1
+    # a second identical update is idempotent on the weights
+    w = dict(b.weights)
+    b.update({"pair": 0.5, "kill": 0.0}, {"kill": 3})
+    assert b.weights == w and b.updates == 2
+    # kinds absent from the band table count as empty (thin) bands,
+    # fractions clamp into [0, 1]
+    b2 = BiasState.fresh(("pair", "kill"))
+    b2.update({"pair": 2.0}, {})
+    assert b2.weights["kill"] == 2.0 / 3.0  # kill: 1*(1+1)=2; pair: 1*(1+0)=1
+
+
+def test_bias_roundtrip_exact():
+    b = BiasState.fresh(("pair", "kill", "torn"))
+    b.update({"pair": 0.123456789, "kill": 0.5}, {"torn": 7})
+    d1 = b.to_dict()
+    b2 = BiasState.from_dict(json.loads(json.dumps(d1)))
+    assert b2.to_dict() == d1
+    assert b2.weights == b.weights  # exact float round-trip via JSON repr
+
+
+def test_bias_escalate_carries_learned_mass():
+    b = BiasState.fresh(("pair", "kill"))
+    b.update({"pair": 1.0, "kill": 0.0}, {})  # kill becomes heavy
+    w_kill = b.weights["kill"]
+    vocab = b.escalate(("pair", "kill"))
+    assert vocab == vocabulary_for(("pair", "kill"), 1)
+    assert b.escalation == 1
+    assert set(b.weights) == set(FAULT_KIND_NAMES[:6])
+    # carried mass keeps kill ahead of the fresh uniform kinds
+    assert b.weights["kill"] > b.weights["dir"]
+    assert abs(sum(b.weights.values()) - 1.0) < 1e-12
+    # learned ordering survives the renormalization
+    assert b.weights["kill"] / b.weights["pair"] == pytest.approx(
+        w_kill / (1 - w_kill)
+    )
+
+
+def test_score_kinds():
+    b = BiasState(kinds=("pair", "kill"), weights={"pair": 0.25, "kill": 0.75})
+    assert b.score_kinds(("pair", "pair")) == 0.5
+    assert b.score_kinds(("kill",)) == 0.75
+    assert b.score_kinds(()) == 0.0
+
+
+def test_band_fractions_from_coverage():
+    cov = {"by_band": {"pair": 64, "kill": 0, "timer": 128}}
+    # slots_log2=10, band_bits=3 -> 128 slots per band
+    fr = band_fractions_from_coverage(cov, 10, 3)
+    assert fr == {"pair": 0.5, "kill": 0.0, "timer": 1.0}
+
+
+# -- selection (pure, stubbed features) ---------------------------------------
+
+
+def _stub_features(kind_of_seed):
+    """schedule_features stand-in: every seed draws ONE fault whose
+    kind index is kind_of_seed(seed)."""
+
+    def feats(_eng, seeds):
+        kinds = np.asarray([[kind_of_seed(int(s))] for s in seeds], np.int32)
+        return {
+            "kinds": kinds,
+            "t_apply": np.zeros_like(kinds),
+            "targets": np.zeros_like(kinds),
+        }
+
+    return feats
+
+
+def test_select_batch_pure_and_deterministic(monkeypatch):
+    from madsim_tpu.search import guided
+
+    monkeypatch.setattr(
+        guided, "schedule_features", _stub_features(lambda s: s % 2)
+    )
+    b = BiasState(kinds=("pair", "kill"),
+                  weights={"pair": 0.1, "kill": 0.9})
+    eng = SimpleNamespace()  # features are stubbed; engine unused
+    args = (b, eng, [11, 22], {1, 2, 3}, 100, 2, 8)
+    seeds1, cur1, nmut1, ops1 = guided._select_batch(*args)
+    seeds2, cur2, nmut2, ops2 = guided._select_batch(*args)
+    assert (seeds1, cur1, nmut1, ops1) == (seeds2, cur2, nmut2, ops2)
+    assert len(seeds1) == 8 and len(set(seeds1)) == 8
+    assert nmut1 == 4  # MUTANT_FRAC of 8
+    # fresh tail is sequential from the cursor, skipping nothing here
+    assert seeds1[nmut1:] == [100, 101, 102, 103]
+    assert cur1 == 104
+    # every mutant is the kill-heavy (odd) candidate when one exists
+    # among its three streams — the bias drives selection
+    for j, s in enumerate(seeds1[:nmut1]):
+        parent = [11, 22][j % 2]
+        cands = [c for _op, c in mutate.children(parent, 2, j)]
+        assert s in cands
+        best = max(cands, key=lambda c: (0.1, 0.9)[c % 2])
+        assert (s % 2) == (best % 2)
+
+
+def test_select_batch_respects_seen_and_budget(monkeypatch):
+    from madsim_tpu.search import guided
+
+    monkeypatch.setattr(
+        guided, "schedule_features", _stub_features(lambda s: 0)
+    )
+    b = BiasState.fresh(("pair", "kill"))
+    # mark every candidate of parent 5's slots as seen: selection must
+    # fall back to fresh seeds and never emit a duplicate
+    seen = set()
+    for j in range(4):
+        seen.update(c for _op, c in mutate.children(5, 1, j))
+    seen.update({200, 202})
+    seeds, cursor, nmut, _ops = guided._select_batch(
+        b, SimpleNamespace(), [5], seen, 200, 1, 6
+    )
+    assert nmut == 0
+    assert seeds == [201, 203, 204, 205, 206, 207]  # seen skipped
+    assert cursor == 208
+    assert not (set(seeds) & seen)
+
+
+def test_select_batch_bootstrap_is_sequential(monkeypatch):
+    from madsim_tpu.search import guided
+
+    seeds, cursor, nmut, ops = guided._select_batch(
+        BiasState.fresh(("pair",)), SimpleNamespace(), [], set(), 0, 0, 5
+    )
+    assert seeds == [0, 1, 2, 3, 4] and cursor == 5 and nmut == 0
+
+
+# -- engine half: features, guided loop, escalation ---------------------------
+
+
+@pytest.fixture(scope="module")
+def raft_engine():
+    from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
+    from madsim_tpu.models.raft import RaftMachine
+
+    return Engine(
+        RaftMachine(num_nodes=3, log_capacity=8),
+        EngineConfig(
+            horizon_us=1_000_000, queue_capacity=64, coverage=True,
+            provenance=True, cov_slots_log2=10, cov_band_bits_min=4,
+            faults=FaultPlan(n_faults=2, t_max_us=600_000),
+        ),
+    )
+
+
+def _guided_args(**over):
+    d = dict(machine="raft", nodes=3, seed=0, seeds=96, batch=32,
+             max_steps=600, horizon=1.0, loss=0.0, faults=2,
+             fault_tmax=600_000, fault_kinds="pair,kill", rng_stream=2,
+             strict_restart=False, coverage=True, provenance=True,
+             stop_on_plateau=0, stats=None, stream=True, guided=True,
+             checkpoint=None, stop_after_batches=0)
+    d.update(over)
+    return SimpleNamespace(**d)
+
+
+def _trail_key(agg):
+    """Everything the reproducibility contract pins, JSON-canonical."""
+    return json.dumps({
+        "completed": agg["completed"],
+        "failing": sorted(map(list, agg["failing"])),
+        "abandoned": sorted(agg["abandoned"]),
+        "provenance": {str(k): v for k, v in agg["provenance"].items()},
+        "guided": agg["guided"],
+        "slots": agg["stats"].get("coverage", {}).get("slots_hit"),
+    }, sort_keys=True)
+
+
+def test_schedule_features_match_provenance_derivation(raft_engine):
+    """The vectorized feature slice must re-derive exactly the schedule
+    the provenance decoder (and the device) sees."""
+    from madsim_tpu.engine.provenance import fault_schedule
+    from madsim_tpu.search.features import schedule_features
+
+    # (fault_schedule's jitted slice takes int32-weak python ints, so
+    # stay under 2^31 — guided selection feeds uint32 arrays instead)
+    seeds = [0, 7, 1234, 1_987_654_321]
+    feats = schedule_features(raft_engine, seeds)
+    assert feats["kinds"].shape == (4, 2)
+    for i, seed in enumerate(seeds):
+        sched = fault_schedule(raft_engine, seed)
+        assert [int(k) for k in feats["kinds"][i]] == [f.kind for f in sched]
+        assert [int(t) for t in feats["t_apply"][i]] == [
+            f.t_apply_us for f in sched
+        ]
+        assert [int(a) for a in feats["targets"][i]] == [
+            f.arg1 for f in sched
+        ]
+
+
+def test_guided_resume_byte_identical(raft_engine, tmp_path, capsys):
+    """A guided hunt interrupted at a batch boundary and resumed must
+    recompute the IDENTICAL (seed schedule, bias state) trail and final
+    aggregates — the reproducibility half of the acceptance criteria."""
+    from madsim_tpu.search.guided import run_guided
+
+    ck = str(tmp_path / "guided.ck.json")
+    full = run_guided(raft_engine, _guided_args(), purpose="hunt")
+    assert full["batches_run"] == 3
+    assert full["guided"]["trail"][1]["mutants"] > 0  # corpus engaged
+
+    part = run_guided(
+        raft_engine, _guided_args(checkpoint=ck, stop_after_batches=1),
+        purpose="hunt",
+    )
+    assert part["batches_run"] == 1
+    capsys.readouterr()
+    resumed = run_guided(
+        raft_engine, _guided_args(checkpoint=ck), purpose="hunt"
+    )
+    assert "resumed at batch 2/3" in capsys.readouterr().out
+    assert _trail_key(resumed) == _trail_key(full)
+    # the checkpoint records the done flag + the full guided state
+    doc = json.load(open(ck))
+    assert doc["done"] is True
+    assert doc["guided"]["bias"] == resumed["guided"]["bias"]
+    assert [r["seeds"] for r in doc["guided"]["trail"]] == [
+        r["seeds"] for r in resumed["guided"]["trail"]
+    ]
+
+
+def test_guided_checkpoint_refuses_unguided_resume(raft_engine, tmp_path):
+    from madsim_tpu.search.guided import run_guided
+
+    ck = str(tmp_path / "guided.ck.json")
+    run_guided(
+        raft_engine, _guided_args(checkpoint=ck, stop_after_batches=1),
+        purpose="hunt",
+    )
+    from madsim_tpu.__main__ import _stream_batches
+
+    with pytest.raises(SystemExit, match="guided"):
+        _stream_batches(
+            raft_engine, _guided_args(checkpoint=ck, guided=False)
+        )
+
+
+def test_guided_cell_plateau_escalates(raft_engine):
+    """The coarse cell grid saturating must climb the ladder (recorded
+    in the trail) instead of stopping the hunt."""
+    from madsim_tpu.search.guided import run_guided
+
+    agg = run_guided(
+        raft_engine,
+        _guided_args(seeds=320, batch=32, stop_on_plateau=1),
+        purpose="hunt",
+    )
+    trail = agg["guided"]["trail"]
+    esc_events = [r for r in trail if r["escalated_to"]]
+    assert esc_events, "expected at least one escalation in 10 batches"
+    first = esc_events[0]
+    assert first["escalated_to"] == 1
+    # batches after the event run the widened vocabulary
+    later = [r for r in trail if r["batch"] > first["batch"]]
+    for r in later[:1]:
+        assert r["escalation"] >= 1
+        assert "storm" in r["kinds"]
+    assert agg["plateau"] is False  # escalation, not stop
+    # cells_hit is recorded (the escalation trigger's own signal)
+    assert all(isinstance(r["cells_hit"], int) for r in trail)
+
+
+def test_engine_for_escalation_cache_and_step0(raft_engine):
+    from madsim_tpu.search.guided import engine_for_escalation
+
+    assert engine_for_escalation(raft_engine, 0) is raft_engine
+    e1 = engine_for_escalation(raft_engine, 1)
+    assert e1 is engine_for_escalation(raft_engine, 1)  # cached
+    assert e1.config.faults.allow_storm and e1.config.faults.allow_delay
+    assert not e1.config.faults.allow_torn
+    # the coverage layout never moves across escalations
+    assert e1.cov_band_bits == raft_engine.cov_band_bits == 4
+
+
+def test_cov_band_bits_min_validation_and_default():
+    from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
+    from madsim_tpu.models.echo import EchoMachine
+
+    m = EchoMachine(rounds=3)
+    # default 0 = derived (3-bit for the legacy vocabulary): unguided
+    # engines are untouched by the new knob
+    e = Engine(m, EngineConfig(horizon_us=100_000, queue_capacity=32,
+                               faults=FaultPlan(n_faults=0)))
+    assert e.config.cov_band_bits_min == 0 and e.cov_band_bits == 3
+    with pytest.raises(ValueError, match="cov_band_bits_min"):
+        Engine(m, EngineConfig(horizon_us=100_000, queue_capacity=32,
+                               cov_band_bits_min=2,
+                               faults=FaultPlan(n_faults=0)))
+
+
+def test_guided_cli_validation():
+    from madsim_tpu.__main__ import cmd_hunt
+
+    with pytest.raises(SystemExit, match="--stream"):
+        cmd_hunt(_guided_args(stream=False))
+    with pytest.raises(SystemExit, match="--coverage"):
+        cmd_hunt(_guided_args(coverage=False))
+
+
+# -- slow tier: streaming agreement + fleet worker replacement ----------------
+
+
+@pytest.mark.slow
+def test_run_seed_batch_agrees_with_stream(raft_engine):
+    """The guided batch runner and the streaming executor must report
+    the same verdict set for the same seed range (both are the same
+    per-lane simulation by the determinism contract)."""
+    out_b = raft_engine.run_seed_batch(range(0, 64), max_steps=600)
+    out_s = raft_engine.run_stream(
+        64, batch=64, segment_steps=128, seed_start=0, max_steps=600
+    )
+    assert sorted(out_b["failing"]) == sorted(out_s["failing"])
+    assert sorted(out_b["infra"]) == sorted(out_s["infra"])
+    assert sorted(out_b["abandoned"]) == sorted(out_s["abandoned"])
+    # identical coverage bits: same events, same map
+    assert (out_b["coverage_map"] == out_s["coverage_map"]).all()
+
+
+@pytest.mark.slow
+def test_fleet_guided_worker_replacement_byte_identical(tmp_path):
+    """A guided job interrupted by worker death and finished by a
+    REPLACEMENT worker must produce a byte-identical result (report,
+    finds, bias trail) to an uninterrupted oracle run — the fleet half
+    of the reproducibility acceptance."""
+    from madsim_tpu.fleet.store import JobStore
+    from madsim_tpu.fleet.worker import FleetWorker
+
+    spec = {
+        "machine": "raft", "nodes": 3, "seeds": 96, "batch": 32,
+        "horizon": 1.0, "max_steps": 600, "queue": 64, "faults": 2,
+        "fault_tmax": 600_000, "fault_kinds": "pair,kill",
+        "coverage": True, "provenance": True, "guided": True,
+    }
+
+    oracle_store = JobStore(str(tmp_path / "oracle"))
+    oj = oracle_store.submit(dict(spec))
+    FleetWorker(str(tmp_path / "oracle"), worker_id="wO",
+                poll_s=0.01).run(drain=True)
+    oracle = oracle_store.get(oj.id)
+
+    store = JobStore(str(tmp_path / "farm"))
+    job = store.submit(dict(spec))
+    # worker A dies after 2 units (SIGKILL equivalent: lease left open)
+    FleetWorker(str(tmp_path / "farm"), worker_id="wA", poll_s=0.01,
+                lease_ttl_s=0.05).run(drain=False, max_units=2)
+    import time as wall
+
+    wall.sleep(0.1)  # let wA's lease expire
+    # replacement worker B reclaims and finishes
+    FleetWorker(str(tmp_path / "farm"), worker_id="wB",
+                poll_s=0.01).run(drain=True)
+    final = store.get(job.id)
+    assert final.terminal
+    assert json.dumps(final.result, sort_keys=True) == json.dumps(
+        oracle.result, sort_keys=True
+    )
+    rep = final.result["report"]
+    assert rep["guided"]["trail"], "guided trail must ride the result"
