@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test stest rtest check lint bench rpc-bench explore examples audit
+.PHONY: test stest rtest check lint lint-fast bench rpc-bench explore examples audit
 
 # full suite (host engine + TPU engine on a hermetic 8-dev CPU mesh)
 test:
@@ -35,6 +35,12 @@ check:
 # determinism & contract static analysis (pre-commit friendly exits)
 lint:
 	$(PY) -m madsim_tpu lint madsim_tpu/
+
+# cached re-lint for the edit loop / pre-commit hook: unchanged files
+# replay from .madsim-lint-cache/ (a no-change re-run is <2 s);
+# --no-import-check keeps it jax-free — CI runs the import half cold
+lint-fast:
+	$(PY) -m madsim_tpu lint madsim_tpu/ --cache --no-import-check
 
 # flagship benchmark (one JSON line; real chip when available)
 bench:

@@ -19,13 +19,15 @@ import os
 import sys
 from typing import Dict, List, Optional, Sequence
 
-from . import crules, drules, grules
+from . import crules, drules, grules, layers, lintcache, rrules, trules
 from .findings import (
     DEFAULT_BASELINE_NAME,
     Finding,
     apply_baseline,
+    baseline_growth,
     filter_suppressed,
     load_baseline,
+    sarif_doc,
     save_baseline,
 )
 
@@ -50,6 +52,23 @@ def add_lint_args(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--github", action="store_true",
         help="GitHub workflow-command annotations (::error file=...)",
+    )
+    p.add_argument(
+        "--sarif", default=None, metavar="OUT.sarif",
+        help="also write a SARIF 2.1.0 report to this path (composable "
+             "with any output mode)",
+    )
+    p.add_argument(
+        "--cache", action="store_true",
+        help="reuse .madsim-lint-cache/ under the repo root: unchanged "
+             "files replay their findings, a byte-identical repo "
+             "replays the whole-program passes (the lint-fast / "
+             "pre-commit path; CI stays cold)",
+    )
+    p.add_argument(
+        "--force", action="store_true",
+        help="with --update-baseline: allow the baseline to GROW "
+             "(default is the shrink-only ratchet)",
     )
     p.add_argument(
         "--fix", action="store_true",
@@ -101,6 +120,19 @@ def _rule_selected(rule: str, selector: Optional[Sequence[str]]) -> bool:
     return any(rule == s or rule.startswith(s) for s in selector)
 
 
+def projectmodel_build(root: str, notes: List[str]):
+    from . import projectmodel
+
+    if not os.path.isdir(os.path.join(root, projectmodel.PACKAGE)):
+        notes.append(f"{root}: no {projectmodel.PACKAGE}/ package; "
+                     f"L/T passes skipped")
+        return None
+    model = projectmodel.build_model(root)
+    for rel, err in model.broken:
+        notes.append(f"{rel}: unparseable for the program model ({err})")
+    return model
+
+
 def run_lint(
     paths: Sequence[str],
     *,
@@ -109,15 +141,27 @@ def run_lint(
     repo_root: Optional[str] = None,
     verbose: bool = False,
     notes: Optional[List[str]] = None,
+    use_cache: bool = False,
 ) -> tuple:
     """Run the passes. Returns (findings, source_by_path) BEFORE
-    suppression/baseline filtering — the caller owns policy."""
+    suppression/baseline filtering — the caller owns policy (the cache
+    also stores raw findings, so an edited suppression takes effect on
+    a full cache hit)."""
     import ast as _ast
 
     files = _collect_files(paths)
     findings: List[Finding] = []
     source_by_path: Dict[str, str] = {}
     notes = notes if notes is not None else []
+    selector = [s.strip() for s in rules] if rules else None
+
+    def family_selected(fam: str) -> bool:
+        return selector is None or any(s and s[0] == fam for s in selector)
+
+    root = repo_root or (grules.find_repo_root(files[0]) if files else None)
+    cache = (
+        lintcache.LintCache(root) if use_cache and root is not None else None
+    )
 
     for path in files:
         try:
@@ -127,6 +171,13 @@ def run_lint(
             notes.append(f"{path}: unreadable ({exc!r})")
             continue
         source_by_path[path] = source
+        if cache is not None:
+            key = cache.file_key(source, import_check)
+            cached = cache.get_file(path, key)
+            if cached is not None:
+                findings.extend(cached)
+                continue
+        per_file: List[Finding] = []
         try:
             tree = _ast.parse(source, filename=path)
         except SyntaxError as exc:
@@ -136,8 +187,8 @@ def run_lint(
                 message=f"syntax error: {exc.msg}",
             ))
             continue
-        findings.extend(drules.check_module(tree, source, path))
-        findings.extend(crules.check_module(tree, source, path))
+        per_file.extend(drules.check_module(tree, source, path))
+        per_file.extend(crules.check_module(tree, source, path))
         if import_check:
             from .astutils import machine_classes
 
@@ -145,31 +196,74 @@ def run_lint(
                 c_findings, skipped = crules.check_module_contracts(
                     tree, source, path
                 )
-                findings.extend(c_findings)
+                per_file.extend(c_findings)
                 notes.extend(skipped)
+        if cache is not None:
+            cache.put_file(path, key, per_file)
+        findings.extend(per_file)
 
-    root = repo_root or (grules.find_repo_root(files[0]) if files else None)
     if root is None and files:
         notes.append(
             "no madsim_tpu repo root found above the linted paths; "
-            "G-pass (mirror cross-checks) skipped"
+            "repo passes (G mirror cross-checks, L layer map, T taint, "
+            "R RNG ledger) skipped"
         )
     elif root is not None:
-        g = grules.check_repo(root)
-        # G findings report repo-relative paths; qualify with the root
+        repo_findings: Optional[List[Finding]] = None
+        repo_key = None
+        # the repo cache only serves the FULL-family run (no selector):
+        # a partial run would poison it with partial results
+        if cache is not None and selector is None:
+            repo_key = cache.repo_fileset_key(lintcache.repo_input_files(root))
+            repo_findings = cache.get_repo(repo_key)
+        if repo_findings is None:
+            repo_findings = []
+            if family_selected("G"):
+                repo_findings.extend(grules.check_repo(root))
+            if family_selected("L") or family_selected("T"):
+                model = projectmodel_build(root, notes)
+                if model is not None:
+                    if family_selected("L"):
+                        repo_findings.extend(layers.check_model(model))
+                    if family_selected("T"):
+                        repo_findings.extend(trules.check_model(model))
+            if family_selected("R"):
+                repo_findings.extend(rrules.check_repo(root))
+            if cache is not None and repo_key is not None:
+                cache.put_repo(repo_key, repo_findings)
+        # repo passes report repo-relative paths; qualify with the root
         # when linting from elsewhere so editors can open them
         if os.path.abspath(root) != os.path.abspath(os.getcwd()):
-            g = [
+            repo_findings = [
                 Finding(
                     rule=f.rule, severity=f.severity,
                     path=os.path.join(root, f.path), line=f.line,
                     col=f.col, message=f.message, fixable=f.fixable,
                 )
-                for f in g
+                for f in repo_findings
             ]
-        findings.extend(g)
+        findings.extend(repo_findings)
+        # line-anchored repo findings (L/T/R) support inline
+        # suppressions — make their sources visible to the filter
+        for f in repo_findings:
+            if f.line > 0 and f.path not in source_by_path:
+                candidate = (
+                    f.path if os.path.isabs(f.path)
+                    else os.path.join(root, f.path)
+                )
+                try:
+                    with open(candidate, "r", encoding="utf-8") as fh:
+                        source_by_path[f.path] = fh.read()
+                except OSError:
+                    pass
 
-    selector = [s.strip() for s in rules] if rules else None
+    if cache is not None:
+        cache.save()
+        if verbose:
+            notes.append(
+                f"cache: {cache.hits} file hit(s), {cache.misses} miss(es)"
+            )
+
     findings = [f for f in findings if _rule_selected(f.rule, selector)]
 
     # dedup (the taint pass can flag one expression through two node
@@ -243,6 +337,7 @@ def main(args: argparse.Namespace) -> int:
             repo_root=repo_root,
             verbose=args.verbose,
             notes=notes,
+            use_cache=getattr(args, "cache", False),
         )
     except FileNotFoundError as exc:
         print(f"lint: no such path: {exc}", file=sys.stderr)
@@ -264,6 +359,27 @@ def main(args: argparse.Namespace) -> int:
         target = baseline_path or os.path.join(
             repo_root or os.getcwd(), DEFAULT_BASELINE_NAME
         )
+        # the ratchet: a baseline may SHRINK freely (debt paid down) but
+        # refuses to grow — new findings are new debt, and absorbing
+        # them silently is how a strict baseline rots into a landfill
+        if os.path.exists(target) and not getattr(args, "force", False):
+            try:
+                old_entries = load_baseline(target)
+            except (OSError, ValueError, KeyError) as exc:
+                print(f"lint: bad baseline {target}: {exc}", file=sys.stderr)
+                return 2
+            grown = baseline_growth(old_entries, findings)
+            if grown:
+                print(
+                    f"lint: refusing to GROW the baseline ({len(grown)} "
+                    f"new finding(s) not in {target}) — the ratchet is "
+                    f"shrink-only. Fix or inline-suppress them, or pass "
+                    f"--force to grandfather deliberately:",
+                    file=sys.stderr,
+                )
+                for f in grown:
+                    print(f"  + {f.text()}", file=sys.stderr)
+                return 2
         save_baseline(target, findings)
         print(f"baseline: wrote {len(findings)} finding(s) to {target}")
         return 0
@@ -281,6 +397,16 @@ def main(args: argparse.Namespace) -> int:
     if args.verbose:
         for note in notes:
             print(f"note: {note}", file=sys.stderr)
+
+    if getattr(args, "sarif", None):
+        from .lintcache import RULES_VERSION
+
+        doc = sarif_doc(findings, RULES_VERSION)
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        if not args.json:
+            print(f"sarif: wrote {len(findings)} result(s) to {args.sarif}")
 
     if args.json:
         doc = {

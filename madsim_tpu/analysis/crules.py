@@ -194,9 +194,14 @@ def check_module_contracts(
     if not anchors:
         return [], []
 
-    import jax
-    import jax.numpy as jnp
+    # This function IS the gate the layer map (L003) asks about: the C
+    # import half is the one lint pass allowed to import jax (models
+    # are jax programs), and callers opt in via --no-import-check.
+    import jax  # madsim: allow(L003) — the documented import-check gate
+    import jax.numpy as jnp  # madsim: allow(L003) — same gate
 
+    # madsim: allow(L003) — same gate (engine.machine hosts the Machine
+    # base class the contract checks instantiate)
     from ..engine.machine import (
         Machine,
         TORN_ATOMIC,

@@ -138,6 +138,96 @@ def filter_suppressed(
     return out
 
 
+# -- SARIF -------------------------------------------------------------------
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+# one-line descriptions for the SARIF rules table (and future docs);
+# family entries cover IDs without a specific row
+RULE_CATALOG: Dict[str, str] = {
+    "D001": "wall-clock read in sim code",
+    "D002": "OS/global entropy draw",
+    "D003": "iteration over a set (hash-order leak)",
+    "D004": "id()/builtin hash() (process-varying value)",
+    "D005": "unordered host callback",
+    "D006": "python truthiness on a traced value in a Machine handler",
+    "C001": "self.* mutation inside a pure handler",
+    "C002": "durable_spec() not congruent with init()",
+    "C003": "torn_spec() not a legal refinement of durable_spec()",
+    "C004": "coverage_projection must return one scalar integer word",
+    "C005": "voter/ack bitmask without the 31-node cap",
+    "G001": "flight-recorder counter mirror drift",
+    "G002": "coverage band mirror drift",
+    "G003": "shrink ablation table drift",
+    "G004": "CLI fault-kind vocabulary drift",
+    "G005": "chaos flag missing from the gate-off matrix",
+    "G006": "chaos flag missing from the golden-stream pins",
+    "G007": "K_* index / FaultPlan flag / enabled_kinds ladder drift",
+    "G008": "RNG-layout manifest order violation",
+    "G009": "guided-search escalation ladder drift",
+    "L001": "jax-free module imports a closed module directly",
+    "L002": "jax-free module transitively imports jax",
+    "L003": "ungated lazy jax import / open import_jax gate",
+    "T001": "sync-forcing sink on a traced value (with chain)",
+    "T002": "device fetch inside the per-segment dispatch region",
+    "T003": "use of a donated argument after the donating call",
+    "R001": "RNG word section without a manifest row (or ghost row)",
+    "R002": "consumption site reads past its RNG section",
+    "R003": "RNG cursor walk out of manifest order",
+}
+
+
+def sarif_doc(findings: Sequence[Finding], tool_version: str) -> dict:
+    """Minimal-but-valid SARIF 2.1.0 for CI artifact upload and editor
+    ingestion. Paths pass through as given (repo-relative in CI)."""
+    rule_ids = sorted({f.rule for f in findings} | set(RULE_CATALOG))
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {
+                "text": RULE_CATALOG.get(rid, f"madsim lint rule {rid}")
+            },
+        }
+        for rid in rule_ids
+    ]
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": rule_ids.index(f.rule),
+            "level": "error" if f.severity == Severity.ERROR else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path.replace("\\", "/")},
+                    "region": {
+                        "startLine": max(f.line, 1),
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "madsim-tpu-lint",
+                    "informationUri": "https://github.com/madsim-rs/madsim",
+                    "version": tool_version,
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
 # -- baseline ----------------------------------------------------------------
 
 BASELINE_VERSION = 1
@@ -169,6 +259,27 @@ def save_baseline(path: str, findings: Sequence[Finding]) -> None:
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, indent=2, sort_keys=True)
         fh.write("\n")
+
+
+def baseline_growth(
+    baseline: Sequence[dict], findings: Sequence[Finding]
+) -> List[Finding]:
+    """Findings NOT already covered by the baseline — the entries a
+    `--update-baseline` would ADD. The ratchet is shrink-only: a
+    baseline exists to grandfather the past, never to absorb new debt,
+    so growth refuses without `--force` (count-aware, like
+    apply_baseline: a second identical finding is growth)."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for entry in baseline:
+        budget[_key(entry)] = budget.get(_key(entry), 0) + 1
+    grown: List[Finding] = []
+    for f in findings:
+        k = (f.rule, f.path, f.message)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            grown.append(f)
+    return grown
 
 
 def apply_baseline(

@@ -2249,6 +2249,10 @@ class Engine:
         perf_warmed.add(init_carry)
 
         def drain(c: StreamCarry) -> StreamCarry:
+            # madsim: allow(T002) — this IS a designed sync point: the
+            # ring drain runs only when a ring crosses its drain mark
+            # (or once at stream end), and its cost is budgeted in
+            # stats["drains"]; the T002 contract bans *hidden* fetches
             f_seeds, f_codes, f_provs, f_n, a_seeds, a_n = _dispatch(
                 "ring drain",
                 jax.device_get,
@@ -2280,6 +2284,10 @@ class Engine:
         def poll(c: StreamCarry):
             """The blocking device->host sync: one small counters read."""
             counters = np.asarray(
+                # madsim: allow(T002) — THE designed blocking poll: one
+                # small counters read per dispatch_depth dispatches,
+                # counted in stats["host_syncs"]; everything else in
+                # the dispatch region must stay async
                 _dispatch(
                     "counters poll", jax.device_get, c.counters,
                     span="counters_poll",
