@@ -36,11 +36,14 @@ check:
 lint:
 	$(PY) -m madsim_tpu lint madsim_tpu/
 
-# cached re-lint for the edit loop / pre-commit hook: unchanged files
-# replay from .madsim-lint-cache/ (a no-change re-run is <2 s);
-# --no-import-check keeps it jax-free — CI runs the import half cold
+# cached re-lint for the edit loop / pre-commit hook: --changed scopes
+# the run to git-dirty files + their reverse import-graph dependents
+# (a no-change run exits immediately; the T/S whole-program walks only
+# re-run when the step-path zone moved), --cache replays unchanged
+# files from .madsim-lint-cache/; --no-import-check keeps it jax-free
+# — CI runs everything cold and unscoped
 lint-fast:
-	$(PY) -m madsim_tpu lint madsim_tpu/ --cache --no-import-check
+	$(PY) -m madsim_tpu lint madsim_tpu/ --cache --no-import-check --changed
 
 # flagship benchmark (one JSON line; real chip when available)
 bench:

@@ -2393,8 +2393,11 @@ def main(argv=None) -> int:
         "(wall-clock/entropy/set-order/callback hazards, AST-only), "
         "C-rules (Machine contract: handler purity, durable/torn spec "
         "congruence, coverage projection), G-rules (fault-kind mirror "
-        "and RNG-layout cross-checks). Exit 0 clean / 1 findings / "
-        "2 usage error — pre-commit friendly",
+        "and RNG-layout cross-checks), and the whole-program families "
+        "— L (jax-free layer map), T (traced-value taint/donation), "
+        "R (static RNG ledger), S (sharding readiness: lane-axis "
+        "dataflow vs the collective registry). Exit 0 clean / "
+        "1 findings / 2 usage error — pre-commit friendly",
     )
     from .analysis.cli import add_lint_args
 

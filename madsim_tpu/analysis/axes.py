@@ -1,0 +1,1068 @@
+"""Lane-axis abstract interpretation — the substrate of the S-rules.
+
+The ROADMAP's [scale] item rebuilds `run_stream` on
+`NamedSharding(mesh, P('batch'))` over the lane axis of `StreamCarry`.
+Its stated precondition is a whole-program claim: per-lane state never
+crosses chips except at a few designed collectives. This module is the
+machine that checks it — an abstract interpreter over the
+`projectmodel` call graph that tracks, for every value in the
+streaming step path, whether it still carries the LANE (batch-leading)
+axis:
+
+* **LANE** — a lane-leading array (`[L, ...]`): shards for free under
+  `P('batch')`; any op that reduces/gathers/reshapes ACROSS axis 0
+  becomes a cross-chip collective under the mesh.
+* **CARRY** — a struct of classified leaves (`StreamCarry`,
+  `LaneState`, `BatchResult`): attribute reads classify by the field
+  tables the S-rules declare (`srules.LANE_FIELDS` / `FREE_FIELDS`).
+* **FREE** — no lane axis (scalars, ring buffers, the global coverage
+  map): replicated under the mesh, crossing chips costs nothing.
+* tuples of the above (`("tuple", [...])`) so `lax.while_loop` /
+  `lax.cond` carries thread element-wise.
+
+Propagation is the jnp/lax op semantics the step path actually uses:
+elementwise ops and `where`/`select` join their operands; reductions
+(`.sum()`, `jnp.any`, `lax.reduce`, `np.<ufunc>.reduce`) consult their
+axis argument — minor-axis reductions (`axis=-1`, `axis=1`) are
+lane-parallel, axis-0/axis-None reductions are CROSS-LANE; gathers
+(`x[i]`, `x[-1]`, `x[mask]`, `searchsorted`) on the lane axis are
+cross-lane, leading-slice/`[:, k]`/`take_along_axis(axis=1)` are not;
+`reshape`/`ravel`/`transpose` on a lane value drops the axis (the
+sharding would not survive, so it counts as cross-lane);
+`lax.while_loop`/`lax.cond`/`lax.scan` thread carries element-wise
+through their branch functions; `jax.vmap(f)(...)` produces a LANE
+result and its body is per-lane code (never walked at batch level —
+cross-lane ops are impossible inside it). Helper calls descend
+context-sensitively with real argument axes, memoized; findings carry
+the propagation chain, same shape as `trules`.
+
+A cross-lane op is not automatically a finding: the step path NEEDS a
+few (the while-cond done-mask, the harvest folds, the ring appends).
+Each designed one carries an inline
+
+    # madsim: collective(<name>, reduce=or|sum|any|max|min|gather|scan)
+
+annotation (on the flagged line, or a comment-only line directly above
+— same placement semantics as `# madsim: allow`). The annotation
+*sanitizes* the op's result (a reduced/gathered value no longer
+carries the lane axis) and must name an entry in the committed
+registry (`srules.COLLECTIVES`) — which is exactly the all-reduce plan
+the mesh rebuild implements. Everything else the S-rules refuse; the
+rule semantics themselves live in `srules.py`.
+
+Honesty bar matches `astutils`: syntactic resolution only. Runtime
+indirection (getattr strings, fn tables) is out of scope; `jax.vmap`
+bodies, Pallas kernel fns (reached only as refs through
+`pallas_call`), and modules outside the entry closures are never
+walked. Nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding
+from .projectmodel import (
+    FunctionInfo,
+    ModuleInfo,
+    ProjectModel,
+    resolve_callee,
+)
+from .astutils import dotted_name
+
+# -- the axis lattice ---------------------------------------------------------
+
+FREE = "free"
+LANE = "lane"
+CARRY = "carry"
+
+Axis = object  # FREE | LANE | CARRY | ("tuple", [Axis, ...]) | ("list", Axis)
+
+
+def is_tuple(ax) -> bool:
+    return isinstance(ax, tuple) and len(ax) == 2 and ax[0] == "tuple"
+
+
+def is_list(ax) -> bool:
+    """A HOST container of arrays (python list/set literal, list
+    concatenation, the list `pallas_call` returns): iterating or
+    int-indexing it is host-side plumbing, NOT lane-axis traffic —
+    only its ELEMENTS carry (or don't carry) the lane axis."""
+    return isinstance(ax, tuple) and len(ax) == 2 and ax[0] == "list"
+
+
+def elem_of(ax) -> Axis:
+    return ax[1] if is_list(ax) else collapse(ax)
+
+
+def join(*axes) -> Axis:
+    """Least upper bound; LANE dominates (a value that MIGHT carry the
+    lane axis must be treated as carrying it), CARRY beats FREE.
+    Tuples join element-wise when shapes agree, lists join on their
+    element axis, mixed forms collapse."""
+    if axes and all(is_list(a) for a in axes):
+        return ("list", join(*(a[1] for a in axes)))
+    tuples = [a for a in axes if is_tuple(a)]
+    if tuples:
+        n = len(tuples[0][1])
+        if all(is_tuple(a) and len(a[1]) == n for a in axes):
+            return ("tuple", [
+                join(*(a[1][i] for a in axes)) for i in range(n)
+            ])
+    axes = [collapse(a) for a in axes]
+    if LANE in axes:
+        return LANE
+    if CARRY in axes:
+        return CARRY
+    return FREE
+
+
+def collapse(ax) -> Axis:
+    """A tuple/list axis flattened to one scalar verdict (used when a
+    structured value flows somewhere structure-unaware)."""
+    if is_tuple(ax):
+        return join(*(collapse(a) for a in ax[1])) if ax[1] else FREE
+    if is_list(ax):
+        return collapse(ax[1])
+    return ax
+
+
+def laneish(ax) -> bool:
+    """Does the value (or any element of it) still carry the lane axis?"""
+    return collapse(ax) in (LANE, CARRY)
+
+
+# -- collective annotations ---------------------------------------------------
+
+_COLLECTIVE_RE = re.compile(
+    r"#\s*madsim:\s*collective\(\s*([A-Za-z0-9_-]+)\s*,\s*reduce=([a-z]+)\s*\)"
+)
+
+
+@dataclasses.dataclass
+class Annotation:
+    name: str
+    reduce: str
+    lineno: int  # the comment's own line
+
+
+class CollectiveAnnotations:
+    """Per-file `# madsim: collective(...)` map. `line_map[n]` is the
+    annotation governing code line n (1-based). A comment-only line's
+    annotation extends through the comment block to the first code line
+    below it — same placement contract as inline `allow(...)`."""
+
+    def __init__(self, source: str):
+        self.line_map: Dict[int, Annotation] = {}
+        self.all: List[Annotation] = []
+        lines = source.splitlines()
+        for lineno, text in enumerate(lines, start=1):
+            m = _COLLECTIVE_RE.search(text)
+            if not m:
+                continue
+            ann = Annotation(m.group(1), m.group(2), lineno)
+            self.all.append(ann)
+            self.line_map.setdefault(lineno, ann)
+            if text.lstrip().startswith("#"):
+                target = lineno + 1
+                while (
+                    target <= len(lines)
+                    and lines[target - 1].lstrip().startswith("#")
+                ):
+                    target += 1
+                self.line_map.setdefault(target, ann)
+
+
+# -- op tables ----------------------------------------------------------------
+
+# callables whose name (post import-map) reduces over an axis argument
+_REDUCE_FNS = {
+    "sum", "prod", "mean", "max", "min", "any", "all", "argmin", "argmax",
+    "count_nonzero", "cumsum", "cumprod", "sort", "argsort", "median",
+    "bincount", "nonzero", "unique",
+}
+_REDUCE_PREFIXES = ("jnp.", "jax.numpy.", "np.", "numpy.", "lax.", "jax.lax.")
+# method names on an array receiver with the same axis semantics
+_REDUCE_METHODS = {
+    "sum", "prod", "mean", "max", "min", "any", "all", "argmin", "argmax",
+    "cumsum", "cumprod", "sort", "argsort",
+}
+# axis-dropping reshapes: the sharded axis does not survive these
+_RESHAPE_METHODS = {"reshape", "ravel", "flatten", "transpose", "swapaxes"}
+# gathers whose FIRST array argument is indexed along the given axis
+_GATHER_FNS = {"searchsorted", "take", "compress", "roll", "flip"}
+# python sinks that force the lane axis through host control flow
+_HOST_SINKS = {"len", "int", "float", "bool", "list", "tuple", "sorted",
+               "enumerate", "sum", "max", "min", "any", "all"}
+# attribute reads returning static python regardless of the base's axis
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "nbytes"}
+
+
+def _axis_kw(call: ast.Call, positional: Optional[int] = None):
+    """The reduction's axis argument as a python value: int, tuple of
+    ints, None (explicit axis=None or absent), or "?" when dynamic."""
+    node = None
+    for kw in call.keywords:
+        if kw.arg in ("axis", "dimensions", "axes"):
+            node = kw.value
+    if node is None and positional is not None and len(call.args) > positional:
+        node = call.args[positional]
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant):
+        return node.value  # int or None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub) and \
+            isinstance(node.operand, ast.Constant):
+        return -node.operand.value
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return "?"
+        return tuple(out)
+    return "?"
+
+
+def axis_hits_lane(axis_val) -> bool:
+    """Does this reduction axis touch axis 0 (the lane axis)? None =
+    reduce everything = yes. Negative literals are minor-axis by
+    convention (rank >= 2 on the step path's [L, Q]/[L, N] planes) —
+    EXCEPT the common 1-D case has no minor axis, so a bare `.sum()`
+    with no axis on a 1-D mask is the caller's (frequent) cross-lane
+    fold; `None` covers it."""
+    if axis_val is None:
+        return True
+    if axis_val == "?":
+        return True  # dynamic axis: assume the worst
+    if isinstance(axis_val, int):
+        return axis_val == 0
+    if isinstance(axis_val, tuple):
+        return 0 in axis_val or not axis_val
+    return True
+
+
+# -- cross-lane events --------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CrossLaneOp:
+    """One cross-lane op the interpreter met, annotated or not. The
+    S-rules turn these into findings and the registry audit."""
+
+    kind: str  # reduce | gather | scan | reshape | iterate
+    reduce: str  # or|sum|any|max|min|gather|scan|? — best-effort op class
+    module: str
+    rel: str
+    line: int
+    col: int
+    region: str
+    chain: Tuple[str, ...]
+    detail: str
+    annotation: Optional[Annotation]  # the governing collective(...) if any
+
+
+@dataclasses.dataclass
+class HostSink:
+    """Python control flow / iteration / len() on a lane-carrying value
+    (S003 raw material)."""
+
+    what: str
+    module: str
+    rel: str
+    line: int
+    col: int
+    region: str
+    chain: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class RebuildKwarg:
+    """One keyword at a carry rebuild site (`StreamCarry(...)` or
+    `.replace(...)`) with the computed axis of its value (S002 raw
+    material)."""
+
+    cls: str
+    field: str
+    axis: Axis
+    module: str
+    rel: str
+    line: int
+    col: int
+    chain: Tuple[str, ...]
+
+
+# -- the interpreter ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    module: str
+    qualname: str
+    region: str  # step | segment | init | final
+    params: Dict[str, Axis]
+    pinned: Dict[str, Axis] = dataclasses.field(default_factory=dict)
+
+
+class AxisEngine:
+    """Walk entry contexts, descending through project calls with real
+    argument axes. Collects CrossLaneOp / HostSink / RebuildKwarg
+    events; rule policy lives in srules."""
+
+    def __init__(
+        self,
+        model: ProjectModel,
+        *,
+        lane_fields: Set[str],
+        free_fields: Set[str],
+        carry_fields: Set[str],
+        carry_classes: Set[str],
+        region_overrides: Dict[Tuple[str, str], str],
+        reduce_name: Callable[[str], str] = lambda fn: fn,
+    ):
+        self.model = model
+        self.lane_fields = lane_fields
+        self.free_fields = free_fields
+        self.carry_fields = carry_fields
+        self.carry_classes = carry_classes
+        self.region_overrides = region_overrides
+        self.cross_ops: List[CrossLaneOp] = []
+        self.host_sinks: List[HostSink] = []
+        self.rebuilds: List[RebuildKwarg] = []
+        self.walked_modules: Set[str] = set()
+        self.consumed_annotations: Set[Tuple[str, int]] = set()  # (rel, lineno)
+        self._annotations: Dict[str, CollectiveAnnotations] = {}
+        self._memo: Dict[Tuple, Axis] = {}
+        self._in_progress: Set[Tuple] = set()
+        self._budget = 4000
+
+    # -- entry ----------------------------------------------------------------
+
+    def run(self, entrypoints: Sequence[EntryPoint]) -> None:
+        for ep in entrypoints:
+            fn = self.model.function(ep.module, ep.qualname)
+            if fn is None:
+                continue
+            self._walk(
+                fn, args={**ep.params}, region=ep.region, chain=(),
+                closure=None, pinned=dict(ep.pinned),
+            )
+
+    def annotations_of(self, mi: ModuleInfo) -> CollectiveAnnotations:
+        ann = self._annotations.get(mi.name)
+        if ann is None:
+            ann = self._annotations[mi.name] = CollectiveAnnotations(mi.source)
+        return ann
+
+    # -- function walks -------------------------------------------------------
+
+    def _walk(
+        self,
+        fn: FunctionInfo,
+        args: Dict[str, Axis],
+        region: str,
+        chain: Tuple[str, ...],
+        closure: Optional[Dict[str, Axis]],
+        pinned: Optional[Dict[str, Axis]] = None,
+    ) -> Axis:
+        region = self.region_overrides.get((fn.module, fn.qualname), region)
+        nested = "<locals>" in fn.qualname
+        key = None
+        if not nested and closure is None:
+            key = (
+                fn.module, fn.qualname, region,
+                tuple(sorted((k, repr(v)) for k, v in args.items())),
+            )
+            if key in self._memo:
+                return self._memo[key]
+            if key in self._in_progress:
+                return FREE  # recursion: converge to bottom
+            self._in_progress.add(key)
+        if len(chain) > 10 or self._budget <= 0:
+            if key is not None:
+                self._in_progress.discard(key)
+            return FREE
+        self._budget -= 1
+        self.walked_modules.add(fn.module)
+        env: Dict[str, Axis] = {}
+        if closure is not None:
+            env.update(closure)
+        for p in fn.params:
+            env[p] = args.get(p, FREE)
+        walk = _AxisWalk(
+            self, fn, env=env, region=region,
+            chain=chain + (fn.qualname,), pinned=pinned or {},
+        )
+        walk.run()
+        result = walk.return_axis()
+        if key is not None:
+            self._in_progress.discard(key)
+            self._memo[key] = result
+        return result
+
+
+class _AxisWalk:
+    """One function body, walked twice in document order (round 2
+    approximates loop-carried flows), tracking per-name axis state."""
+
+    def __init__(self, engine: AxisEngine, fn: FunctionInfo,
+                 env: Dict[str, Axis], region: str,
+                 chain: Tuple[str, ...], pinned: Dict[str, Axis]):
+        self.engine = engine
+        self.fn = fn
+        self.mi: ModuleInfo = engine.model.modules[fn.module]
+        self.env = env
+        self.region = region
+        self.chain = chain
+        self.pinned = pinned
+        self.returns: List[Axis] = []
+        self._seen_events: Set[Tuple[str, int, int, str]] = set()
+
+    # -- driver ---------------------------------------------------------------
+
+    def run(self) -> None:
+        body = list(self.fn.node.body)
+        for _round in (1, 2):
+            self.returns = []
+            self._stmts(body)
+
+    def return_axis(self) -> Axis:
+        return join(*self.returns) if self.returns else FREE
+
+    # -- events ---------------------------------------------------------------
+
+    def _cross(self, node: ast.AST, kind: str, reduce: str, detail: str) -> Axis:
+        """Record a cross-lane op at `node`; consult the annotation map.
+        Returns the result axis: sanitized FREE either way (the value no
+        longer lane-indexes after a reduce/gather, and cascading LANE
+        through an already-reported op would only duplicate findings)."""
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        dedup = (self.mi.rel, line, col, kind)
+        if dedup not in self._seen_events:
+            self._seen_events.add(dedup)
+            ann = self.engine.annotations_of(self.mi).line_map.get(line)
+            if ann is not None:
+                self.engine.consumed_annotations.add((self.mi.rel, ann.lineno))
+            self.engine.cross_ops.append(CrossLaneOp(
+                kind=kind, reduce=reduce, module=self.fn.module,
+                rel=self.mi.rel, line=line, col=col, region=self.region,
+                chain=self.chain, detail=detail, annotation=ann,
+            ))
+        return FREE
+
+    def _host_sink(self, node: ast.AST, what: str) -> None:
+        self.engine.host_sinks.append(HostSink(
+            what=what, module=self.fn.module, rel=self.mi.rel,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            region=self.region, chain=self.chain,
+        ))
+
+    def _rebuild(self, call: ast.Call, cls: str) -> None:
+        for kw in call.keywords:
+            if kw.arg is None:
+                self._axis(kw.value)
+                continue
+            self.engine.rebuilds.append(RebuildKwarg(
+                cls=cls, field=kw.arg, axis=self._axis(kw.value),
+                module=self.fn.module, rel=self.mi.rel,
+                line=kw.value.lineno, col=kw.value.col_offset,
+                chain=self.chain,
+            ))
+        for a in call.args:
+            self._axis(a)
+
+    # -- statements -----------------------------------------------------------
+
+    def _stmts(self, stmts) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs walk when called, with the closure env
+        if isinstance(node, ast.Return):
+            if node.value is not None:
+                self.returns.append(self._axis(node.value))
+            else:
+                self.returns.append(FREE)
+            return
+        if isinstance(node, ast.Assign):
+            ax = self._axis(node.value)
+            for tgt in node.targets:
+                self._assign(tgt, ax)
+            return
+        if isinstance(node, ast.AugAssign):
+            ax = join(self._axis(node.value), self._axis(node.target))
+            self._assign(node.target, ax)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._assign(node.target, self._axis(node.value))
+            return
+        if isinstance(node, ast.For):
+            it = self._axis(node.iter)
+            if is_list(it) or is_tuple(it):
+                self._assign(node.target, elem_of(it))
+            elif laneish(it):
+                self._host_sink(node.iter, "for-loop iteration")
+                self._assign(node.target, FREE)
+            else:
+                self._assign(node.target, FREE)
+            self._stmts(node.body)
+            self._stmts(node.orelse)
+            return
+        if isinstance(node, ast.While):
+            self._test_sink(node.test, "while")
+            self._stmts(node.body)
+            self._stmts(node.orelse)
+            return
+        if isinstance(node, ast.If):
+            self._test_sink(node.test, "if")
+            self._stmts(node.body)
+            self._stmts(node.orelse)
+            return
+        if isinstance(node, ast.Assert):
+            self._test_sink(node.test, "assert")
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ax = self._axis(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, ax)
+            self._stmts(node.body)
+            return
+        if isinstance(node, ast.Try):
+            self._stmts(node.body)
+            for h in node.handlers:
+                self._stmts(h.body)
+            self._stmts(node.orelse)
+            self._stmts(node.finalbody)
+            return
+        if isinstance(node, ast.Expr):
+            self._axis(node.value)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._axis(child)
+
+    def _test_sink(self, test: ast.expr, what: str) -> None:
+        ax = self._axis(test)
+        if laneish(ax) and not is_list(ax):
+            self._host_sink(test, f"python `{what}` on a lane-axis value")
+
+    def _assign(self, tgt: ast.expr, ax: Axis) -> None:
+        if isinstance(tgt, ast.Name):
+            if tgt.id in self.pinned:
+                self.env[tgt.id] = self.pinned[tgt.id]
+            else:
+                self.env[tgt.id] = ax
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            if is_tuple(ax) and len(ax[1]) == len(tgt.elts):
+                elems = ax[1]
+            else:
+                elems = [elem_of(ax)] * len(tgt.elts)
+            for e, a in zip(tgt.elts, elems):
+                self._assign(e, a)
+        elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+            self._axis(tgt.value)
+
+    # -- expressions ----------------------------------------------------------
+
+    def _axis(self, node: ast.expr) -> Axis:
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, FREE)
+        if isinstance(node, ast.Constant):
+            return FREE
+        if isinstance(node, ast.Attribute):
+            return self._attr_axis(node)
+        if isinstance(node, ast.Subscript):
+            return self._subscript_axis(node)
+        if isinstance(node, ast.Call):
+            return self._call_axis(node)
+        if isinstance(node, ast.BinOp):
+            left, right = self._axis(node.left), self._axis(node.right)
+            if is_list(left) or is_list(right):
+                # python list concatenation keeps the container form
+                return ("list", join(elem_of(left), elem_of(right)))
+            return join(left, right)
+        if isinstance(node, ast.UnaryOp):
+            return self._axis(node.operand)
+        if isinstance(node, ast.Compare):
+            out = self._axis(node.left)
+            for c in node.comparators:
+                out = join(out, self._axis(c))
+            return out
+        if isinstance(node, ast.BoolOp):
+            return join(*(self._axis(v) for v in node.values))
+        if isinstance(node, ast.IfExp):
+            self._test_sink(node.test, "conditional expression")
+            return join(self._axis(node.body), self._axis(node.orelse))
+        if isinstance(node, ast.Tuple):
+            return ("tuple", [self._axis(e) for e in node.elts])
+        if isinstance(node, (ast.List, ast.Set)):
+            elems = [self._axis(e) for e in node.elts]
+            return ("list", join(*(elem_of(a) for a in elems)) if elems else FREE)
+        if isinstance(node, ast.Dict):
+            out: Axis = FREE
+            for v in node.values:
+                out = join(out, self._axis(v))
+            for k in node.keys:
+                if k is not None:
+                    self._axis(k)
+            return out
+        if isinstance(node, ast.Starred):
+            return self._axis(node.value)
+        if isinstance(node, ast.Lambda):
+            return FREE  # a function object; its body walks when applied
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            for gen in node.generators:
+                it = self._axis(gen.iter)
+                if is_list(it) or is_tuple(it):
+                    self._assign(gen.target, elem_of(it))
+                elif laneish(it):
+                    self._host_sink(gen.iter, "comprehension over the lane axis")
+                    self._assign(gen.target, FREE)
+                else:
+                    self._assign(gen.target, FREE)
+                for cond in gen.ifs:
+                    self._axis(cond)
+            return ("list", elem_of(self._axis(node.elt)))
+        if isinstance(node, ast.DictComp):
+            for gen in node.generators:
+                it = self._axis(gen.iter)
+                if is_list(it) or is_tuple(it):
+                    self._assign(gen.target, elem_of(it))
+                elif laneish(it):
+                    self._host_sink(gen.iter, "comprehension over the lane axis")
+                    self._assign(gen.target, FREE)
+                else:
+                    self._assign(gen.target, FREE)
+            return join(self._axis(node.key), self._axis(node.value))
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self._axis(v.value)
+            return FREE
+        if isinstance(node, ast.NamedExpr):
+            ax = self._axis(node.value)
+            self._assign(node.target, ax)
+            return ax
+        if isinstance(node, ast.Await):
+            return self._axis(node.value)
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self._axis(part)
+            return FREE
+        return FREE
+
+    def _attr_axis(self, node: ast.Attribute) -> Axis:
+        if node.attr in _STATIC_ATTRS:
+            self._axis(node.value)
+            return FREE
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            return FREE  # engine config / cached fns: static host state
+        base = self._axis(node.value)
+        base_c = collapse(base)
+        if base_c == CARRY:
+            if node.attr in self.engine.carry_fields:
+                return CARRY
+            if node.attr in self.engine.lane_fields:
+                return LANE
+            if node.attr in self.engine.free_fields:
+                return FREE
+            return FREE
+        if base_c == LANE:
+            # degraded carry: field classification is lost, every leaf
+            # reads as lane-leading (sound for LaneState, whose leaves
+            # all are; `.at` property rides through unchanged)
+            return LANE
+        return FREE
+
+    def _subscript_axis(self, node: ast.Subscript) -> Axis:
+        base = self._axis(node.value)
+        sl = node.slice
+        # host containers index host-side: element pick / sub-container
+        if is_list(base):
+            self._axis(sl)
+            return base if isinstance(sl, ast.Slice) else base[1]
+        if is_tuple(base):
+            self._axis(sl)
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, int) \
+                    and 0 <= sl.value < len(base[1]):
+                return base[1][sl.value]
+            return elem_of(base)
+        base_c = collapse(base)
+        if base_c not in (LANE, CARRY):
+            self._axis(sl)
+            return base_c
+        # lane-carrying base: classify the index
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            return base_c  # dict-of-lane-arrays access ({"map": ...})
+        if isinstance(sl, ast.Slice):
+            self._axis(sl)
+            return base_c  # leading slice keeps the lane axis
+        if isinstance(sl, ast.Tuple) and sl.elts and isinstance(
+            sl.elts[0], (ast.Slice, ast.Constant)
+        ) and (
+            isinstance(sl.elts[0], ast.Slice)
+            or sl.elts[0].value is Ellipsis
+        ):
+            for e in sl.elts:
+                self._axis(e)
+            return base_c  # [:, k] / [..., None]: lane axis intact
+        if isinstance(sl, ast.Constant) and sl.value is Ellipsis:
+            return base_c
+        # anything else — int literal, negative index, mask, array —
+        # indexes ALONG the lane axis: a cross-lane gather
+        self._axis(sl)
+        return self._cross(
+            node, "gather", "gather",
+            "lane-axis indexed gather (`x[i]`/`x[mask]` drops or "
+            "permutes the sharded axis)",
+        )
+
+    # -- calls ----------------------------------------------------------------
+
+    def _call_axis(self, node: ast.Call) -> Axis:
+        name = dotted_name(node.func)
+        resolved = self.mi.importmap.resolve(name) if name else None
+
+        # jax.vmap(f)(...) / jax.pmap(f)(...): the mapped result is
+        # lane-leading; the body is per-lane code — never walked here
+        if isinstance(node.func, ast.Call):
+            inner = dotted_name(node.func.func)
+            inner_res = self.mi.importmap.resolve(inner) if inner else None
+            if inner_res in ("jax.vmap", "jax.pmap"):
+                for a in node.args:
+                    self._axis(a)
+                return LANE
+            # call of a call we can't see (pallas_call(...)(*ins), cached
+            # runners): a host container of results whose elements join
+            # the outer args — covers the pallas_call list-return idiom
+            # without reading `outs[i]` as a lane gather
+            out: Axis = FREE
+            for a in node.args:
+                out = join(out, elem_of(self._axis(a))
+                           if not isinstance(a, ast.Starred)
+                           else elem_of(self._axis(a.value)))
+            return ("list", collapse(out))
+
+        # control-flow combinators thread carries element-wise
+        if resolved in ("lax.while_loop", "jax.lax.while_loop"):
+            return self._while_loop_axis(node)
+        if resolved in ("lax.cond", "jax.lax.cond"):
+            return self._cond_axis(node)
+        if resolved in ("lax.scan", "jax.lax.scan"):
+            return self._scan_axis(node)
+        if resolved in ("lax.reduce", "jax.lax.reduce"):
+            operand = self._axis(node.args[0]) if node.args else FREE
+            for a in node.args[1:]:
+                self._axis(a)
+            if laneish(operand) and axis_hits_lane(_axis_kw(node, positional=3)):
+                return self._cross(
+                    node, "reduce", "or",
+                    "`lax.reduce` over the lane axis",
+                )
+            return operand if laneish(operand) else FREE
+
+        # np.<ufunc>.reduce(x, axis=...) — the host-side fold idiom
+        if resolved and resolved.endswith(".reduce") and resolved.split(".")[0] in (
+            "np", "numpy", "jnp", "jax"
+        ):
+            operand = self._axis(node.args[0]) if node.args else FREE
+            for a in node.args[1:]:
+                self._axis(a)
+            for kw in node.keywords:
+                self._axis(kw.value)
+            if laneish(operand) and axis_hits_lane(_axis_kw(node)):
+                ufunc = resolved.split(".")[-2]
+                return self._cross(
+                    node, "reduce",
+                    {"bitwise_or": "or", "logical_or": "or", "add": "sum"}.get(
+                        ufunc, "?"
+                    ),
+                    f"`{resolved}` over the lane axis",
+                )
+            return operand
+
+        # reductions by dotted name (jnp.any(x), np.sum(x, axis=0), ...)
+        if resolved:
+            head, _, tail = resolved.rpartition(".")
+            if tail in _REDUCE_FNS and (head + ".") .startswith(_REDUCE_PREFIXES):
+                return self._reduction(node, tail, first_arg=True)
+            if tail in _GATHER_FNS and (head + ".").startswith(_REDUCE_PREFIXES):
+                operand = self._axis(node.args[0]) if node.args else FREE
+                for a in node.args[1:]:
+                    self._axis(a)
+                if laneish(operand):
+                    return self._cross(
+                        node, "gather", "gather",
+                        f"`{resolved}` indexes along the lane axis",
+                    )
+                return FREE
+            if tail == "take_along_axis" and (head + ".").startswith(_REDUCE_PREFIXES):
+                operand = self._axis(node.args[0]) if node.args else FREE
+                for a in node.args[1:]:
+                    self._axis(a)
+                ax_val = _axis_kw(node, positional=2)
+                if laneish(operand) and axis_hits_lane(ax_val):
+                    return self._cross(
+                        node, "gather", "gather",
+                        "`take_along_axis` over the lane axis",
+                    )
+                return operand
+            if tail in ("reshape", "ravel") and (head + ".").startswith(
+                _REDUCE_PREFIXES
+            ):
+                operand = self._axis(node.args[0]) if node.args else FREE
+                for a in node.args[1:]:
+                    self._axis(a)
+                if laneish(operand):
+                    return self._cross(
+                        node, "reshape", "?",
+                        f"`{resolved}` on a lane-axis value — the sharded "
+                        f"axis does not survive a reshape",
+                    )
+                return FREE
+
+        # python host sinks on lane values (S003 raw material); host
+        # containers (len of a list of arrays) are plumbing, not traffic
+        if resolved in _HOST_SINKS and "." not in (resolved or "."):
+            args_ax = [self._axis(a) for a in node.args]
+            if any(
+                laneish(a) and not is_list(a) and not is_tuple(a)
+                for a in args_ax
+            ):
+                self._host_sink(node, f"`{resolved}()` on a lane-axis value")
+            return FREE
+
+        # method calls on an array receiver
+        if isinstance(node.func, ast.Attribute):
+            recv_attr = node.func.attr
+            if recv_attr in _REDUCE_METHODS:
+                recv = self._axis(node.func.value)
+                for a in node.args:
+                    self._axis(a)
+                for kw in node.keywords:
+                    self._axis(kw.value)
+                if laneish(recv) and axis_hits_lane(_axis_kw(node)):
+                    return self._cross(
+                        node, "reduce",
+                        {"sum": "sum", "any": "any", "all": "any",
+                         "max": "max", "min": "min", "cumsum": "scan",
+                         "cumprod": "scan"}.get(recv_attr, "?"),
+                        f"`.{recv_attr}()` over the lane axis",
+                    )
+                return recv if laneish(recv) else FREE
+            if recv_attr in _RESHAPE_METHODS:
+                recv = self._axis(node.func.value)
+                for a in node.args:
+                    self._axis(a)
+                if laneish(recv):
+                    return self._cross(
+                        node, "reshape", "?",
+                        f"`.{recv_attr}()` on a lane-axis value — the "
+                        f"sharded axis does not survive",
+                    )
+                return FREE
+            if recv_attr in ("astype", "copy", "clip", "block_until_ready",
+                            "tolist", "item", "squeeze", "view"):
+                recv = self._axis(node.func.value)
+                for a in node.args:
+                    self._axis(a)
+                if recv_attr in ("tolist", "item"):
+                    return FREE
+                return recv
+            if recv_attr == "replace":
+                recv = self._axis(node.func.value)
+                if collapse(recv) == CARRY:
+                    # flax struct rebuild: same S002 site as a constructor
+                    cls = self._carry_class_of(node.func.value)
+                    self._rebuild(node, cls or "replace")
+                    return CARRY
+            if recv_attr in ("set", "add", "multiply", "get"):  # .at[w].set(v)
+                recv = self._axis(node.func.value)
+                for a in node.args:
+                    self._axis(a)
+                return recv
+
+        # project calls descend with real argument axes
+        kind, target = resolve_callee(node, self.fn, self.engine.model)
+        if kind == "project":
+            assert isinstance(target, FunctionInfo)
+            if target.class_name is None and target.qualname in \
+                    self.engine.carry_classes:
+                pass  # constructor resolved as fn — handled below
+            args = self._map_args(node, target)
+            closure = None
+            if "<locals>" in target.qualname and target.module == self.fn.module:
+                closure = dict(self.env)  # nested def: python closure
+            return self.engine._walk(
+                target, args=args, region=self.region, chain=self.chain,
+                closure=closure,
+            )
+
+        # carry constructors (rebuild sites)
+        if resolved:
+            tail = resolved.split(".")[-1]
+            if tail in self.engine.carry_classes:
+                self._rebuild(node, tail)
+                return CARRY
+
+        # np.asarray keeps the axis (a host copy still lane-indexes);
+        # np.zeros/arange/... make fresh FREE values
+        if resolved in ("np.asarray", "numpy.asarray", "np.array",
+                        "numpy.array", "jnp.asarray", "jax.numpy.asarray"):
+            return join(*(self._axis(a) for a in node.args)) if node.args else FREE
+
+        # extern/opaque: conservative join of arguments
+        out: Axis = FREE
+        for a in node.args:
+            out = join(out, self._axis(a))
+        for kw in node.keywords:
+            out = join(out, self._axis(kw.value))
+        return collapse(out)
+
+    def _carry_class_of(self, node: ast.expr) -> Optional[str]:
+        """Best-effort class name for a `.replace()` receiver: `c` ->
+        look for the nearest carry constructor assigned to that name in
+        this body; falls back to None (reported as `replace`)."""
+        if not isinstance(node, ast.Name):
+            return None
+        for n in ast.walk(self.fn.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    n.targets[0].id == node.id and isinstance(n.value, ast.Call):
+                cname = dotted_name(n.value.func)
+                if cname and cname.split(".")[-1] in self.engine.carry_classes:
+                    return cname.split(".")[-1]
+        return None
+
+    def _reduction(self, node: ast.Call, opname: str, first_arg: bool) -> Axis:
+        operand = self._axis(node.args[0]) if node.args else FREE
+        for a in node.args[1:]:
+            self._axis(a)
+        for kw in node.keywords:
+            self._axis(kw.value)
+        if laneish(operand) and axis_hits_lane(_axis_kw(node)):
+            return self._cross(
+                node, "scan" if opname in ("cumsum", "cumprod") else "reduce",
+                {"sum": "sum", "any": "any", "all": "any", "max": "max",
+                 "min": "min", "cumsum": "scan", "bincount": "sum"}.get(
+                    opname, "?"
+                ),
+                f"`{opname}` over the lane axis",
+            )
+        return operand if laneish(operand) else FREE
+
+    # -- combinators ----------------------------------------------------------
+
+    def _branch_fn(self, node: ast.expr) -> Optional[FunctionInfo]:
+        if isinstance(node, ast.Name) and node.id in self.fn.locals_fns:
+            return self.mi.functions.get(self.fn.locals_fns[node.id])
+        name = dotted_name(node)
+        if name is not None:
+            call = ast.Call(func=node, args=[], keywords=[])
+            ast.copy_location(call, node)
+            kind, target = resolve_callee(call, self.fn, self.engine.model)
+            if kind == "project":
+                return target  # type: ignore[return-value]
+        return None
+
+    def _apply_branch(self, branch: ast.expr, args: List[Axis]) -> Axis:
+        if isinstance(branch, ast.Lambda):
+            lam_env = dict(self.env)
+            params = [p.arg for p in branch.args.args]
+            for p, a in zip(params, args):
+                lam_env[p] = a
+            sub = _AxisWalk(
+                self.engine, self.fn, env=lam_env, region=self.region,
+                chain=self.chain, pinned={},
+            )
+            # lambdas have an expression body; evaluate it directly
+            return sub._axis(branch.body)
+        target = self._branch_fn(branch)
+        if target is None:
+            return join(*args) if args else FREE
+        params = [p for p in target.params if p != "self"]
+        mapped = {p: a for p, a in zip(params, args)}
+        closure = None
+        if "<locals>" in target.qualname and target.module == self.fn.module:
+            closure = dict(self.env)
+        return self.engine._walk(
+            target, args=mapped, region=self.region, chain=self.chain,
+            closure=closure,
+        )
+
+    def _while_loop_axis(self, node: ast.Call) -> Axis:
+        if len(node.args) < 3:
+            return FREE
+        cond, body, init = node.args[0], node.args[1], node.args[2]
+        init_ax = self._axis(init)
+        self._apply_branch(cond, [init_ax])
+        self._apply_branch(body, [init_ax])
+        return init_ax
+
+    def _cond_axis(self, node: ast.Call) -> Axis:
+        if len(node.args) < 3:
+            return FREE
+        pred, t_branch, f_branch = node.args[0], node.args[1], node.args[2]
+        self._axis(pred)
+        operands = [self._axis(a) for a in node.args[3:]]
+        return join(
+            self._apply_branch(t_branch, operands),
+            self._apply_branch(f_branch, operands),
+        )
+
+    def _scan_axis(self, node: ast.Call) -> Axis:
+        if len(node.args) < 2:
+            return FREE
+        f, init = node.args[0], node.args[1]
+        init_ax = self._axis(init)
+        xs_ax = [self._axis(a) for a in node.args[2:]]
+        if any(laneish(a) for a in xs_ax):
+            # scanning OVER the lane axis serializes the lanes — the
+            # exact opposite of the sharding plan
+            return self._cross(
+                node, "scan", "scan",
+                "`lax.scan` over the lane axis (serializes the lanes)",
+            )
+        self._apply_branch(f, [init_ax, FREE])
+        return ("tuple", [init_ax, FREE])
+
+    # -- argument mapping -----------------------------------------------------
+
+    def _map_args(self, call: ast.Call, target: FunctionInfo) -> Dict[str, Axis]:
+        params = [p for p in target.params if p != "self"]
+        out: Dict[str, Axis] = {}
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                ax = self._axis(a.value)
+                for p in params[i:]:
+                    out[p] = collapse(ax)
+                break
+            if i < len(params):
+                out[params[i]] = self._axis(a)
+            else:
+                self._axis(a)
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in params:
+                out[kw.arg] = self._axis(kw.value)
+            else:
+                self._axis(kw.value)
+        return out
+
+
+def make_finding(rule: str, severity: str, rel: str, line: int, col: int,
+                 message: str) -> Finding:
+    return Finding(
+        rule=rule, severity=severity, path=rel, line=line, col=col,
+        message=message,
+    )

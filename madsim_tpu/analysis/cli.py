@@ -19,7 +19,7 @@ import os
 import sys
 from typing import Dict, List, Optional, Sequence
 
-from . import crules, drules, grules, layers, lintcache, rrules, trules
+from . import crules, drules, grules, layers, lintcache, rrules, srules, trules
 from .findings import (
     DEFAULT_BASELINE_NAME,
     Finding,
@@ -57,6 +57,16 @@ def add_lint_args(p: argparse.ArgumentParser) -> None:
         "--sarif", default=None, metavar="OUT.sarif",
         help="also write a SARIF 2.1.0 report to this path (composable "
              "with any output mode)",
+    )
+    p.add_argument(
+        "--changed", action="store_true",
+        help="git-diff-scoped run: lint only files git reports changed "
+             "(staged + unstaged + untracked) plus their reverse "
+             "import-graph dependents; the whole-program passes scope "
+             "to the zones the change can reach (T handler walks to "
+             "the changed files, the T-executor/S step-path walks only "
+             "when engine/ops/parallel/utils changed). The pre-commit "
+             "path — a no-change run exits immediately",
     )
     p.add_argument(
         "--cache", action="store_true",
@@ -133,6 +143,64 @@ def projectmodel_build(root: str, notes: List[str]):
     return model
 
 
+# -- git-diff scoping (`lint --changed`) --------------------------------------
+
+# A change under these prefixes can move the step path's lane-axis /
+# taint behavior — the T-executor and S walks re-run; anything else
+# leaves the step path byte-identical and those walks are skipped.
+STEP_PATH_PREFIXES = (
+    "madsim_tpu/engine/", "madsim_tpu/ops/", "madsim_tpu/parallel/",
+    "madsim_tpu/utils",
+)
+
+
+def git_changed_files(root: str) -> Optional[List[str]]:
+    """Repo-relative paths git reports as changed (staged, unstaged and
+    untracked). None when git is unavailable or `root` is not a work
+    tree — callers fall back to a full run."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+    out: List[str] = []
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        if " -> " in path:  # rename: the new side is the linted one
+            path = path.split(" -> ")[-1]
+        out.append(path.strip().strip('"'))
+    return out
+
+
+def scoped_files(model, root: str, changed: Sequence[str]) -> List[str]:
+    """Absolute paths of the changed package files PLUS their reverse
+    import-graph dependents (a change to a module can move findings in
+    every module that imports it — eagerly or lazily)."""
+    rev: Dict[str, set] = {}
+    for mi in model.modules.values():
+        for edge in mi.imports:
+            for target in model._project_targets(edge.target):
+                rev.setdefault(target, set()).add(mi.name)
+    by_rel = {mi.rel: mi for mi in model.modules.values()}
+    queue = [by_rel[rel].name for rel in changed if rel in by_rel]
+    seen = set(queue)
+    while queue:
+        cur = queue.pop()
+        for dep in rev.get(cur, ()):
+            if dep not in seen:
+                seen.add(dep)
+                queue.append(dep)
+    return sorted(model.modules[name].path for name in seen)
+
+
 def run_lint(
     paths: Sequence[str],
     *,
@@ -142,11 +210,15 @@ def run_lint(
     verbose: bool = False,
     notes: Optional[List[str]] = None,
     use_cache: bool = False,
+    changed: Optional[Sequence[str]] = None,
 ) -> tuple:
     """Run the passes. Returns (findings, source_by_path) BEFORE
     suppression/baseline filtering — the caller owns policy (the cache
     also stores raw findings, so an edited suppression takes effect on
-    a full cache hit)."""
+    a full cache hit). `changed` (repo-relative paths, the --changed
+    scope) restricts the per-file passes to changed files + their
+    reverse import-graph dependents and scopes the whole-program
+    walks; None = everything."""
     import ast as _ast
 
     files = _collect_files(paths)
@@ -162,6 +234,18 @@ def run_lint(
     cache = (
         lintcache.LintCache(root) if use_cache and root is not None else None
     )
+
+    model = None
+    if changed is not None and root is not None:
+        model = projectmodel_build(root, notes)
+        if model is not None:
+            scope = set(scoped_files(model, root, changed))
+            before = len(files)
+            files = [f for f in files if os.path.abspath(f) in scope]
+            notes.append(
+                f"--changed: {len(files)}/{before} file(s) in scope "
+                f"({len(changed)} changed)"
+            )
 
     for path in files:
         try:
@@ -211,22 +295,42 @@ def run_lint(
     elif root is not None:
         repo_findings: Optional[List[Finding]] = None
         repo_key = None
-        # the repo cache only serves the FULL-family run (no selector):
-        # a partial run would poison it with partial results
-        if cache is not None and selector is None:
+        # the repo cache only serves the FULL run (no selector, no
+        # --changed scope): a partial run would poison it
+        if cache is not None and selector is None and changed is None:
             repo_key = cache.repo_fileset_key(lintcache.repo_input_files(root))
             repo_findings = cache.get_repo(repo_key)
         if repo_findings is None:
             repo_findings = []
+            # --changed scope for the expensive walks: the T-executor
+            # and S step-path contexts only move when the step-path
+            # zone moved; T handler walks scope to the changed files
+            step_zone_touched = changed is None or any(
+                rel.startswith(STEP_PATH_PREFIXES) for rel in changed
+            )
             if family_selected("G"):
                 repo_findings.extend(grules.check_repo(root))
-            if family_selected("L") or family_selected("T"):
-                model = projectmodel_build(root, notes)
+            if family_selected("L") or family_selected("T") \
+                    or family_selected("S"):
+                if model is None:
+                    model = projectmodel_build(root, notes)
                 if model is not None:
                     if family_selected("L"):
                         repo_findings.extend(layers.check_model(model))
                     if family_selected("T"):
-                        repo_findings.extend(trules.check_model(model))
+                        if changed is None:
+                            repo_findings.extend(trules.check_model(model))
+                        else:
+                            repo_findings.extend(trules.check_model(
+                                model,
+                                executor_entrypoints=(
+                                    trules.EXECUTOR_ENTRYPOINTS
+                                    if step_zone_touched else ()
+                                ),
+                                handler_files=set(changed),
+                            ))
+                    if family_selected("S") and step_zone_touched:
+                        repo_findings.extend(srules.check_model(model))
             if family_selected("R"):
                 repo_findings.extend(rrules.check_repo(root))
             if cache is not None and repo_key is not None:
@@ -329,6 +433,27 @@ def main(args: argparse.Namespace) -> int:
         if fixed_total and not args.json:
             print(f"--fix applied {fixed_total} edit(s); re-linting")
 
+    changed = None
+    if getattr(args, "changed", False):
+        git_root = repo_root or grules.find_repo_root(
+            paths[0] if paths else os.getcwd()
+        )
+        changed = git_changed_files(git_root) if git_root else None
+        if changed is None:
+            notes.append("--changed: git unavailable here; full run")
+        else:
+            # lint-relevant inputs: package sources plus the repo-pass
+            # cross-check files (golden/gate test pins, the RNG manifest)
+            changed = [
+                r for r in changed
+                if (r.startswith("madsim_tpu/") and r.endswith(".py"))
+                or r in (grules.GATES_TEST, grules.GOLDEN_TEST, grules.MANIFEST)
+            ]
+            if not changed:
+                if not args.json and not args.github:
+                    print("lint: --changed: no lint-relevant files changed")
+                return 0
+
     try:
         findings, sources = run_lint(
             paths,
@@ -338,6 +463,7 @@ def main(args: argparse.Namespace) -> int:
             verbose=args.verbose,
             notes=notes,
             use_cache=getattr(args, "cache", False),
+            changed=changed,
         )
     except FileNotFoundError as exc:
         print(f"lint: no such path: {exc}", file=sys.stderr)

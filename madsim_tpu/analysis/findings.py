@@ -178,6 +178,10 @@ RULE_CATALOG: Dict[str, str] = {
     "R001": "RNG word section without a manifest row (or ghost row)",
     "R002": "consumption site reads past its RNG section",
     "R003": "RNG cursor walk out of manifest order",
+    "S001": "cross-lane op outside the collective registry (or registry drift)",
+    "S002": "carry leaf without a lane-axis declaration / lane data into a global leaf",
+    "S003": "lane-axis-dependent python control flow in the step path",
+    "S004": "collective placed in the per-event inner loop",
 }
 
 

@@ -36,7 +36,7 @@ from .findings import Finding
 
 # Bump whenever any rule's behavior changes — the cache must never
 # serve findings computed by older rule semantics.
-RULES_VERSION = "lint-v2.0"
+RULES_VERSION = "lint-v2.1"  # v2.1: the S family (sharding readiness)
 
 CACHE_DIR = ".madsim-lint-cache"
 CACHE_FILE = "cache.json"

@@ -863,9 +863,15 @@ class _BodyWalk:
 def check_model(
     model: ProjectModel,
     executor_entrypoints: Sequence[Tuple[str, str]] = EXECUTOR_ENTRYPOINTS,
+    handler_files: Optional[Set[str]] = None,
 ) -> List[Finding]:
+    """`handler_files` (repo-relative paths) restricts the Machine
+    handler context walks — the `lint --changed` scope; None = all."""
     engine = TaintEngine(model)
-    findings = engine.run(executor_entrypoints=executor_entrypoints)
+    findings = engine.run(
+        executor_entrypoints=executor_entrypoints,
+        handler_files=handler_files,
+    )
     # stable order + dedup across the two-round body walks
     seen = set()
     out: List[Finding] = []

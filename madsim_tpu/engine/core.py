@@ -1813,6 +1813,9 @@ class Engine:
 
         def cond(carry):
             s, it = carry
+            # madsim: collective(segment-done-any, reduce=any) — the
+            # while-cond early-exit mask: under the mesh this is the one
+            # designed per-event-step collective (a 1-bit or-all-reduce)
             return (it < segment_steps) & jnp.any(~(s.done | s.failed))
 
         def body(carry):
@@ -1872,11 +1875,13 @@ class Engine:
             one-hot matrix) — so it stays cheap at pod-scale batches.
             Entries past capacity are dropped; the host's drain policy
             makes that unreachable."""
+            # madsim: collective(ring-append-ranks, reduce=scan)
             csum = jnp.cumsum(mask.astype(jnp.int32))  # [L], rank+1 at masked lanes
             n_new = csum[-1]
             want_rank = jnp.arange(cap, dtype=jnp.int32) - count + 1  # 1-based
             src = jnp.searchsorted(csum, want_rank, side="left").astype(jnp.int32)
             fills = (want_rank >= 1) & (want_rank <= n_new)
+            # madsim: collective(ring-append-gather, reduce=gather)
             vals = values[jnp.clip(src, 0, mask.shape[0] - 1)]
             buf = jnp.where(fills, vals, buf)
             return buf, count + n_new
@@ -1911,6 +1916,7 @@ class Engine:
                 state=self.init_batch(seeds),
                 seeds=seeds,
                 done=jnp.zeros((seeds.shape[0],), bool),
+                # madsim: collective(seed-counter-init, reduce=gather)
                 next_seed=seeds[-1] + jnp.uint32(1),
                 completed=jnp.int32(0),
                 segments=jnp.int32(0),
@@ -1943,9 +1949,10 @@ class Engine:
         def _segment_impl(c: StreamCarry) -> StreamCarry:
             # 1. refill lanes harvested at the end of the previous segment
             #    (device-side ranks + seed counter: gapless, in lane order)
-            n_refill = c.done.sum(dtype=jnp.int32)
+            n_refill = c.done.sum(dtype=jnp.int32)  # madsim: collective(refill-count, reduce=sum)
 
             def do_refill(_):
+                # madsim: collective(refill-ranks, reduce=scan)
                 ranks = jnp.cumsum(c.done.astype(jnp.int32)) - 1
                 fresh_seeds = c.next_seed + ranks.astype(jnp.uint32)
                 fresh = self.init_batch(fresh_seeds)
@@ -1969,7 +1976,7 @@ class Engine:
             #    seeds/codes and abandoned (over-cap) seeds
             over_cap = state.step >= max_steps
             done = state.done | state.failed | over_cap
-            completed = c.completed + done.sum(dtype=jnp.int32)
+            completed = c.completed + done.sum(dtype=jnp.int32)  # madsim: collective(harvest-completed, reduce=sum)
             fail_mask = done & state.failed
             fail_seeds, fail_count = _append_ring(
                 c.fail_seeds, c.fail_count, fail_mask, seeds
@@ -1998,11 +2005,13 @@ class Engine:
                 frs = state.fr
                 nk = len(FAULT_KIND_NAMES)
                 ne = len(FR_EXTRA_NAMES)
+                # madsim: collective(fr-fold, reduce=sum)
                 inj_tot = fr_metrics[:nk] + (
                     frs["inj"] * done[:, None].astype(jnp.int32)
                 ).sum(axis=0)
                 extra_tot = jnp.stack(
                     [
+                        # madsim: collective(fr-fold, reduce=sum)
                         fr_metrics[nk + i] + jnp.where(done, frs[k], 0).sum()
                         for i, k in enumerate(FR_EXTRA_NAMES)
                     ]
@@ -2011,6 +2020,7 @@ class Engine:
                     [
                         jnp.maximum(
                             fr_metrics[nk + ne + i],
+                            # madsim: collective(fr-hwm, reduce=max)
                             jnp.where(done, frs[k], 0).max(),
                         )
                         for i, k in enumerate(("q_hwm", "clog_hwm", "kill_hwm"))
@@ -2025,6 +2035,7 @@ class Engine:
             # coverage to the live curve the host polls.
             cov_map = c.cov_map
             if self.config.coverage:
+                # madsim: collective(cov-map-or, reduce=or)
                 cov_map = cov_map | lax.reduce(
                     state.cov["map"], jnp.int32(0), lax.bitwise_or, (0,)
                 )
@@ -2503,6 +2514,7 @@ class Engine:
         failed = np.asarray(res.failed)
         codes = np.asarray(res.fail_code)
         failing, infra = [], []
+        # madsim: collective(final-fail-gather, reduce=gather)
         for s, c in zip(seeds_np[failed].tolist(), codes[failed].tolist()):
             (infra if int(c) == OVERFLOW else failing).append(
                 (int(s), int(c))
@@ -2513,6 +2525,7 @@ class Engine:
             "infra": infra,
             # over the step budget without finishing: the fixed path's
             # abandonment criterion, mirroring the streaming harvest
+            # madsim: collective(final-abandoned-gather, reduce=gather)
             "abandoned": [int(s) for s in seeds_np[~done & ~failed]],
             "seeds_consumed": int(seeds_np.shape[0]),
             "stats": {},
@@ -2523,6 +2536,7 @@ class Engine:
             lane_words = np.asarray(res.cov["map"])
             out["cov_lane_words"] = lane_words
             out["coverage_map"] = unpack_map(
+                # madsim: collective(final-cov-or, reduce=or)
                 np.bitwise_or.reduce(lane_words, axis=0),
                 self.config.cov_slots_log2,
             )
@@ -2530,7 +2544,9 @@ class Engine:
             out["provenance"] = {
                 int(s): int(p)
                 for s, p in zip(
+                    # madsim: collective(final-prov-gather, reduce=gather)
                     seeds_np[failed].tolist(),
+                    # madsim: collective(final-prov-gather, reduce=gather)
                     np.asarray(res.fail_prov)[failed].tolist(),
                 )
             }
@@ -2539,6 +2555,7 @@ class Engine:
     def failing_seeds(self, result: BatchResult) -> jax.Array:
         """Gather the failing lane seeds back to the host
         (the only device->host traffic besides summaries)."""
+        # madsim: collective(final-fail-gather, reduce=gather)
         return result.seeds[result.failed]
 
     def ring_trace(self, result, lane: int):

@@ -145,9 +145,19 @@ def run_batch_global(
 
     replicated = NamedSharding(mesh, P())
 
+    # The audited cross-lane baseline of this (pre-pipelined-executor)
+    # module — the worklist the lane-axis sharding rebuild starts from.
+    # Each op carries its S-rule collective annotation; the registry
+    # entries (analysis/srules.py COLLECTIVES, multihost-*) record the
+    # all-reduce each becomes under NamedSharding(mesh, P('batch')):
+    # the ranks scan + masked ring gather stay the ONLY cross-host
+    # data movement (failing lanes only, never a full [L] all-gather),
+    # and the completion count is already a psum by virtue of the
+    # replicated out_shardings.
     @partial(jax.jit, out_shardings=replicated)
     def stats(r):
         mask = r.failed
+        # madsim: collective(multihost-fail-ranks, reduce=scan)
         csum = jnp.cumsum(mask.astype(jnp.int32))
         n_fail = csum[-1] if mask.shape[0] else jnp.int32(0)
         want = jnp.arange(fail_capacity, dtype=jnp.int32) + 1
@@ -158,9 +168,12 @@ def run_batch_global(
         )
         fill = want <= n_fail
         return {
+            # madsim: collective(multihost-completed-sum, reduce=sum)
             "completed": r.done.sum(dtype=jnp.int32),
             "failed": n_fail,
+            # madsim: collective(multihost-fail-ring, reduce=gather)
             "fail_seeds": jnp.where(fill, r.seeds[src], 0),
+            # madsim: collective(multihost-fail-ring, reduce=gather)
             "fail_codes": jnp.where(fill, r.fail_code[src], 0),
         }
 

@@ -1,0 +1,79 @@
+"""S-rule fixture: a miniature streaming executor for lane-axis tracking.
+
+Each `seg_*` method is walked as its own entry context by
+tests/test_lint_v2.py with a fixture-local registry/axis-table binding
+(mini-done-any / mini-count; FakeCarry.state lane, FakeCarry.count
+global). Lines tagged `S00x expected` must be flagged with exactly that
+rule; untagged lines must stay clean. The file is never imported — it
+exists to be parsed.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class FakeCarry:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class MiniStream:
+    def seg_clean(self, c):
+        """Scan-carry threading + `where` on mixed-axis operands: the
+        lane mask rides a while_loop carry, keeps its axis through the
+        thread, and every cross-lane fold is annotated."""
+
+        def cond(carry):
+            s, it = carry
+            # madsim: collective(mini-done-any, reduce=any)
+            return (it < 4) & jnp.any(~s.done)
+
+        def body(carry):
+            s, it = carry
+            return s, it + 1
+
+        final, _ = lax.while_loop(cond, body, (c.state, jnp.int32(0)))
+        # mixed-axis select: lane mask, lane value, scalar fill — the
+        # result stays lane-parallel, nothing to flag
+        mixed = jnp.where(final.done, final.step, jnp.int32(0))
+        # madsim: collective(mini-count, reduce=sum)
+        return mixed.sum()
+
+    def seg_unannotated_sum(self, c):
+        return c.state.step.sum()  # S001 expected
+
+    def seg_scan_carry_leak(self, c):
+        """A cross-lane fold smuggled into the while-loop body: the
+        carry threading keeps `s.done` lane-axis, so the fold inside
+        the per-event loop is both undeclared and misplaced."""
+
+        def body(carry):
+            s, it = carry
+            bad = s.done.astype(jnp.int32).sum()  # S001 expected S004 expected
+            return s, it + bad
+
+        final, _ = lax.while_loop(
+            lambda carry: carry[1] < jnp.int32(2), body,
+            (c.state, jnp.int32(0)),
+        )
+        return final
+
+    def seg_reshape_drops_lane(self, c):
+        return c.state.step.reshape((-1,))  # S001 expected
+
+    def seg_rebuild_leaf(self, c):
+        done = c.state.done
+        return FakeCarry(
+            state=c.state,
+            count=done,  # S002 expected
+        )
+
+    def seg_host_if(self, c):
+        if c.state.done:  # S003 expected
+            return 1
+        return 0
+
+    def seg_unregistered(self, c):
+        # madsim: collective(no-such-entry, reduce=sum)
+        return c.state.done.sum()  # S001 expected

@@ -14,8 +14,9 @@ import shutil
 
 import pytest
 
-from madsim_tpu.analysis import layers, lintcache, projectmodel, rrules, trules
-from madsim_tpu.analysis.cli import main as lint_main, run_lint
+from madsim_tpu.analysis import layers, lintcache, projectmodel, rrules, srules, trules
+from madsim_tpu.analysis.axes import CARRY, EntryPoint
+from madsim_tpu.analysis.cli import main as lint_main, run_lint, scoped_files
 from madsim_tpu.analysis.findings import (
     Finding,
     baseline_growth,
@@ -606,6 +607,212 @@ def test_sarif_severity_mapping():
     )
     levels = {r["ruleId"]: r["level"] for r in doc["runs"][0]["results"]}
     assert levels == {"T001": "warning", "T003": "error"}
+
+
+# -- S-rules (lane-axis sharding readiness) -----------------------------------
+
+_MINI_COLLECTIVES = {
+    "mini-done-any": srules.Collective("any", ("segment",), "fixture"),
+    "mini-count": srules.Collective("sum", ("segment",), "fixture"),
+}
+_MINI_AXES = {
+    "FakeCarry": {"state": "lane", "count": "global"},
+    "MiniState": {"done": "lane", "step": "lane"},
+}
+
+
+@pytest.fixture(scope="module")
+def saxes_model(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("saxes")
+    root = tmp / "repo"
+    dst = root / "madsim_tpu" / "saxes_stream.py"
+    dst.parent.mkdir(parents=True)
+    shutil.copy(os.path.join(FIXTURES, "saxes_stream.py"), dst)
+    (root / "madsim_tpu" / "__init__.py").write_text("")
+    return projectmodel.build_model(str(root))
+
+
+_S_ENTRIES = (
+    ("MiniStream.seg_clean", "segment"),
+    ("MiniStream.seg_unannotated_sum", "segment"),
+    ("MiniStream.seg_scan_carry_leak", "step"),
+    ("MiniStream.seg_reshape_drops_lane", "segment"),
+    ("MiniStream.seg_rebuild_leaf", "segment"),
+    ("MiniStream.seg_host_if", "segment"),
+    ("MiniStream.seg_unregistered", "segment"),
+)
+
+
+def s_findings(model, entries, audit=False):
+    return srules.check_model(
+        model,
+        entrypoints=[
+            EntryPoint("madsim_tpu.saxes_stream", qual, region, {"c": CARRY})
+            for qual, region in entries
+        ],
+        collectives=_MINI_COLLECTIVES,
+        carry_axes=_MINI_AXES,
+        audited_classes=(),
+        carry_classes={"FakeCarry", "MiniState"},
+        carry_fields={"state"},
+        region_overrides={},
+        audit_registry=audit,
+    )
+
+
+def test_saxes_clean_entry_stays_clean(saxes_model):
+    """Scan-carry threading keeps the lane axis through the while_loop
+    AND the annotated folds stay silent; `where` on mixed-axis operands
+    is lane-parallel (no finding)."""
+    assert s_findings(saxes_model, _S_ENTRIES[:1]) == []
+
+
+def test_saxes_unannotated_sum_is_s001(saxes_model):
+    found = s_findings(saxes_model, [_S_ENTRIES[1]])
+    assert [f.rule for f in found] == ["S001"]
+    assert "chain:" in found[0].message
+
+
+def test_saxes_scan_carry_leak_is_s001_and_s004(saxes_model):
+    """The fold smuggled into the while-loop body: undeclared (S001)
+    and misplaced in the per-event region (S004), on the same line."""
+    found = s_findings(saxes_model, [_S_ENTRIES[2]])
+    assert sorted(f.rule for f in found) == ["S001", "S004"]
+    assert len({f.line for f in found}) == 1
+
+
+def test_saxes_reshape_drops_lane_is_s001(saxes_model):
+    found = s_findings(saxes_model, [_S_ENTRIES[3]])
+    assert [f.rule for f in found] == ["S001"]
+    assert "reshape" in found[0].message
+
+
+def test_saxes_rebuild_global_leaf_is_s002(saxes_model):
+    """The donated-rebuild hazard: a lane-axis value fed into a
+    global-declared carry leaf at a rebuild site."""
+    found = s_findings(saxes_model, [_S_ENTRIES[4]])
+    assert [f.rule for f in found] == ["S002"]
+    assert "count" in found[0].message and "global" in found[0].message
+
+
+def test_saxes_host_if_is_s003(saxes_model):
+    found = s_findings(saxes_model, [_S_ENTRIES[5]])
+    assert [f.rule for f in found] == ["S003"]
+
+
+def test_saxes_unregistered_annotation_is_s001(saxes_model):
+    found = s_findings(saxes_model, [_S_ENTRIES[6]])
+    assert [f.rule for f in found] == ["S001"]
+    assert "no entry in the registry" in found[0].message
+
+
+def test_saxes_expected_lines_match_tags(saxes_model):
+    """Every tagged line is flagged with exactly its rule, nothing
+    untagged fires, and the registry audit is clean when every entry
+    context runs (both fixture collectives are consumed)."""
+    path = os.path.join(FIXTURES, "saxes_stream.py")
+    found = s_findings(saxes_model, _S_ENTRIES, audit=True)
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, set()).add(f.line)
+    for rule in ("S001", "S002", "S003", "S004"):
+        assert by_rule.get(rule, set()) == set(
+            tagged_lines(path, f"{rule} expected")
+        ), (rule, sorted(by_rule.get(rule, set())))
+
+
+_S_CORE_FILES = (
+    "madsim_tpu/__init__.py",
+    "madsim_tpu/engine/__init__.py",
+    "madsim_tpu/engine/core.py",
+    "madsim_tpu/parallel/__init__.py",
+    "madsim_tpu/parallel/multihost.py",
+    "madsim_tpu/ops/__init__.py",
+    "madsim_tpu/ops/pallas_pop.py",
+    "madsim_tpu/utils/__init__.py",
+)
+
+
+@pytest.fixture()
+def s_core_repo(tmp_path):
+    root = tmp_path / "repo"
+    for rel in _S_CORE_FILES:
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    return root
+
+
+def test_s_real_executor_clean_then_mutated(s_core_repo):
+    """The CI mutation-smoke shape against the REAL executor: the
+    unmutated scratch copy is clean (every cross-lane op annotated and
+    registered); injecting a `jnp.sum(axis=0)` into the per-event
+    segment body fires S001 with the propagation chain AND S004 for
+    the placement; stripping the while-cond annotation fires S001 at
+    the now-undeclared op plus the stale-registry-row error."""
+    model = projectmodel.build_model(str(s_core_repo))
+    assert srules.check_model(model) == [], [
+        f.text() for f in srules.check_model(model)
+    ]
+
+    p = s_core_repo / "madsim_tpu" / "engine" / "core.py"
+    src = p.read_text()
+    needle = (
+        "        def body(carry):\n"
+        "            s, it = carry\n"
+        "            return self.step_batch(s), it + 1"
+    )
+    assert needle in src, "executor anchor moved; update this test"
+    p.write_text(src.replace(needle, needle.replace(
+        "            return self.step_batch(s), it + 1",
+        "            _probe = jnp.sum(s.msg_count.astype(jnp.int32), axis=0)\n"
+        "            return self.step_batch(s), it + 1",
+    )))
+    found = srules.check_model(projectmodel.build_model(str(s_core_repo)))
+    s001 = [f for f in found if f.rule == "S001"]
+    assert s001 and "chain: Engine.run_segment" in s001[0].message
+    assert any(f.rule == "S004" for f in found)
+
+    ann = "# madsim: collective(segment-done-any, reduce=any)"
+    assert ann in src, "annotation anchor moved; update this test"
+    p.write_text(src.replace(ann, "# (stripped)"))
+    found = srules.check_model(projectmodel.build_model(str(s_core_repo)))
+    assert any(f.rule == "S001" and f.line > 0 for f in found)
+    assert any(
+        f.rule == "S001" and "segment-done-any" in f.message and f.line == 0
+        for f in found
+    )
+
+
+def test_s_head_is_clean(repo_model):
+    """The sharding-readiness contract holds at HEAD: every cross-lane
+    op in the step/harvest paths is either lane-parallel by analysis or
+    carries a registered collective annotation; the registry has no
+    stale rows; every carry leaf is axis-declared."""
+    assert srules.check_model(repo_model) == [], [
+        f.text() for f in srules.check_model(repo_model)
+    ]
+
+
+# -- lint --changed (git-diff scoping) ----------------------------------------
+
+
+def test_scoped_files_reverse_dependents(tmp_path):
+    model = model_of(tmp_path, {
+        "madsim_tpu/__init__.py": "",
+        "madsim_tpu/base.py": "X = 1\n",
+        "madsim_tpu/mid.py": "from .base import X\n",
+        "madsim_tpu/top.py": "from .mid import X\n",
+        "madsim_tpu/other.py": "Y = 2\n",
+    })
+    root = str(tmp_path / "repo")
+    scope = scoped_files(model, root, ["madsim_tpu/base.py"])
+    rels = {os.path.relpath(p, root) for p in scope}
+    # the changed module + everything that (transitively) imports it;
+    # the unrelated module stays out of scope
+    assert {"madsim_tpu/base.py", "madsim_tpu/mid.py",
+            "madsim_tpu/top.py"} <= rels
+    assert "madsim_tpu/other.py" not in rels
 
 
 # -- the D006 fixture keeps passing (satellite pin) ---------------------------
