@@ -155,6 +155,8 @@ def _main_impl() -> None:
     with maybe_span("engine_build"):
         from madsim_tpu.compile_cache import (
             active_compile_cache,
+            aot_cache_dir,
+            aot_enabled,
             cache_subkey,
             enable_compile_cache,
             measure_warm_compile,
@@ -195,6 +197,13 @@ def _main_impl() -> None:
     # then carries a `provenance_off` line (acceptance: the lineage
     # dataflow costs <= 5% of the step).
     provenance = os.environ.get("MADSIM_TPU_PROVENANCE", "0") not in ("", "0")
+    # Buffered coverage (r12): default = the engine's buffered fold
+    # (flush-on-freeze slot buffer); MADSIM_TPU_COV_BUFFER=0 restores
+    # the per-event map scatter for an A/B (maps bit-identical).
+    cov_buffer_env = os.environ.get("MADSIM_TPU_COV_BUFFER", "")
+    cov_buffer_kw = (
+        {} if cov_buffer_env == "" else {"cov_buffer": int(cov_buffer_env)}
+    )
     cfg = EngineConfig(
         horizon_us=5_000_000,
         # 32 slots: the real-chip queue sweep (PROFILE_r2.md) — the [L, Q]
@@ -208,6 +217,7 @@ def _main_impl() -> None:
         flight_recorder=flight_recorder,
         coverage=coverage,
         provenance=provenance,
+        **cov_buffer_kw,
     )
     # Persistent compilation cache (opt-in MADSIM_TPU_COMPILE_CACHE=dir):
     # sweeps and repeated bench captures pay the multi-second streaming
@@ -225,6 +235,7 @@ def _main_impl() -> None:
                 "clog_packed": clog_packed,
                 "flight_recorder": flight_recorder,
                 "coverage": coverage,
+                "cov_buffer": cfg.cov_buffer,
                 "provenance": provenance,
             },
             rng_stream=rng_stream,
@@ -245,30 +256,49 @@ def _main_impl() -> None:
         batch=lanes, segment_steps=segment_steps, pipelined=pipelined,
     )
 
-    # Warmup 1: compile the streaming path at the timed batch size —
-    # timed separately so the emitted JSON splits one-time compile cost
-    # from steady state. This is `compile_s_cold`: what the FIRST
-    # process of this (jax, gates, shape) tuple pays. When a persistent
-    # cache is active, the warm path is then measured honestly: drop
-    # every in-process jit cache and rebuild a fresh engine against the
-    # entries the cold compile just wrote — `compile_s_warm` is what
-    # every SUBSEQUENT worker/restart pays (trace + deserialize).
-    # Warmup 2: a full-size untimed run to bring the chip to a steady
-    # power/clock state (a cold first rep reads 10-20% low); it also
-    # re-absorbs the executable reload the warm measurement forced on
-    # the main engine.
+    # Compile timing (r12: COMPILE-ONLY, via Engine.compile_stream's
+    # .lower().compile() forcing — no stream execution in the timed
+    # window). `compile_s_cold` is what the FIRST process of this
+    # (jax, gates, shape) tuple pays before it can dispatch; when a
+    # persistent cache is active the warm path is then measured the
+    # same way against the entries the cold compile just wrote —
+    # `compile_s_warm` is what every SUBSEQUENT worker/restart pays
+    # (trace or AOT deserialize + XLA cache hit). Through r11 these
+    # keys timed a full run(1), which CONFLATED the start cost with
+    # the first dispatch's fixed-shape execution (~17 s of the r11
+    # flagship "warm 18.2 s" was the 8192-wide dispatch itself running
+    # on the 1-core box, not compile); rows with a `trace_s` key carry
+    # the honest split.
     t0 = time.perf_counter()
-    run(1)
+    eng.compile_stream(batch=lanes, segment_steps=segment_steps)
     compile_s = time.perf_counter() - t0
+
+    # Pure-trace share of the compile (r12): lower the streaming
+    # supersegment once more AFTER the timed cold run — jax re-traces on
+    # every explicit .lower(), so this measures the abstract-trace cost
+    # without perturbing the cold number. trace_s is the floor a warm
+    # worker pays even when every XLA executable deserializes from the
+    # persistent cache; the AOT supersegment path (MADSIM_TPU_AOT_CACHE)
+    # is what removes it.
+    with maybe_span("trace_measure"):
+        trace_s = eng.measure_stream_trace(
+            batch=lanes, segment_steps=segment_steps
+        )
 
     def _warm_build_and_run():
         fresh = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
-        fresh.make_stream_runner(
-            batch=lanes, segment_steps=segment_steps, pipelined=pipelined
-        )(1)
+        fresh.compile_stream(batch=lanes, segment_steps=segment_steps)
 
+    # MADSIM_TPU_BENCH_COLD_TRACE=1: measure the warm rebuild with the
+    # AOT artifact cache dropped too — "warm" then means persistent XLA
+    # cache only (trace + deserialize), the honest pre-AOT warm number
+    cold_trace = (
+        os.environ.get("MADSIM_TPU_BENCH_COLD_TRACE", "0") not in ("", "0")
+    )
     with maybe_span("compile_warm"):
-        compile_s_warm = measure_warm_compile(_warm_build_and_run)
+        compile_s_warm = measure_warm_compile(
+            _warm_build_and_run, cold_trace=cold_trace
+        )
     run(2 * lanes, seed_start=500_000)
 
     # Timed: `reps` independent repetitions over disjoint seed ranges;
@@ -331,6 +361,12 @@ def _main_impl() -> None:
                          dataclasses.replace(cfg, flight_recorder=False), {}))
         if cfg.coverage:
             menu.append(("coverage_off", dataclasses.replace(cfg, coverage=False), {}))
+        if cfg.coverage and cfg.cov_buffer:
+            # the r12 escape hatch: coverage ON but the pre-buffer
+            # per-event map scatter (cov_buffer=0) — the delta is what
+            # the flush-on-freeze buffered fold pays off
+            menu.append(("coverage_unbuffered",
+                         dataclasses.replace(cfg, cov_buffer=0), {}))
         if cfg.provenance:
             menu.append(("provenance_off",
                          dataclasses.replace(cfg, provenance=False), {}))
@@ -390,8 +426,12 @@ def _main_impl() -> None:
         "pallas_megakernel": eng.use_megakernel,
         "flight_recorder": cfg.flight_recorder,
         "coverage": cfg.coverage,
+        "cov_buffer": cfg.cov_buffer,
         "provenance": cfg.provenance,
         "compile_cache": active_compile_cache(),
+        # AOT supersegment artifacts (jax.export): when set, warm
+        # workers deserialize the traced program instead of re-tracing
+        "aot_cache": aot_cache_dir() if aot_enabled() else None,
     }
     repo_dir = os.path.dirname(os.path.abspath(__file__))
     hist_path = os.environ.get("MADSIM_TPU_BENCH_HISTORY") or os.path.join(
@@ -439,6 +479,7 @@ def _main_impl() -> None:
             compile_s_warm=(
                 round(compile_s_warm, 2) if compile_s_warm is not None else None
             ),
+            trace_s=round(trace_s, 2),
             spread_pct=round(100 * (max(rates) - min(rates)) / max(rates), 1),
             host_load1=load1,
             step_cost=step_cost,
@@ -474,6 +515,12 @@ def _main_impl() -> None:
                     round(compile_s_warm, 2)
                     if compile_s_warm is not None else None
                 ),
+                # the pure abstract-trace share of a compile, measured
+                # by re-lowering the supersegment post-cold: the floor
+                # a warm worker pays even when every XLA executable
+                # deserializes — unless the AOT artifact path
+                # (MADSIM_TPU_AOT_CACHE) removes the trace too
+                "trace_s": round(trace_s, 2),
                 "steady_seeds_per_sec": round(seeds_per_sec, 1),
                 # active step-path gates: BENCH_r* files stay
                 # self-describing across this PR's flags

@@ -172,6 +172,10 @@ def _build_engine_inner(args, Engine, EngineConfig, FaultPlan):
         rng_stream=getattr(args, "rng_stream", 2),
         flight_recorder=bool(getattr(args, "flight_recorder", False)),
         coverage=bool(getattr(args, "coverage", False)),
+        # None = keep the engine default (buffered); 0 = the unbuffered
+        # escape hatch (per-event map scatter); maps bit-identical either way
+        **({} if getattr(args, "cov_buffer", None) is None
+           else {"cov_buffer": int(args.cov_buffer)}),
         provenance=bool(getattr(args, "provenance", False)),
         compile_cache_dir=getattr(args, "compile_cache", None),
         faults=FaultPlan(
@@ -1563,7 +1567,7 @@ def cmd_perf(args) -> int:
 
 
 _AB_GATES = ("flight_recorder", "coverage", "provenance", "clog-packed",
-             "rng-stream")
+             "rng-stream", "coverage-unbuffered")
 
 
 def cmd_bench_ab(args) -> int:
@@ -1586,6 +1590,14 @@ def cmd_bench_ab(args) -> int:
         cfg_a = dataclasses.replace(base, rng_stream=3)
         cfg_b = dataclasses.replace(base, rng_stream=2)
         label_a, label_b = "rng_stream=3", "rng_stream=2"
+    elif args.gate == "coverage-unbuffered":
+        # the r12 escape hatch's own cost: the flush-on-freeze buffered
+        # fold (cov_buffer default) vs the old per-event map scatter
+        # (cov_buffer=0) with coverage ON in both — final maps are
+        # bit-identical, so the delta is pure fold mechanics
+        cfg_a = dataclasses.replace(base, coverage=True)
+        cfg_b = dataclasses.replace(base, coverage=True, cov_buffer=0)
+        label_a, label_b = "cov_buffer=on", "cov_buffer=0"
     else:
         field = args.gate.replace("-", "_")
         cfg_a = dataclasses.replace(base, **{field: True})
@@ -1777,6 +1789,12 @@ def main(argv=None) -> int:
             "context), OR-reduced on device at stream harvest (results "
             "are bit-identical either way; enables --stop-on-plateau "
             "and `coverage` reports)",
+        )
+        p.add_argument(
+            "--cov-buffer", type=int, default=None, metavar="N",
+            help="coverage slot-buffer depth per lane (default: engine "
+            "default; 0 = unbuffered escape hatch, the per-event map "
+            "scatter — final maps are bit-identical either way)",
         )
         p.add_argument(
             "--provenance", action="store_true",

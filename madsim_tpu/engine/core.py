@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import logging
 from functools import partial
 from typing import Any, Optional, Tuple
 
@@ -37,20 +38,25 @@ from jax import lax
 
 import os
 
+_stream_log = logging.getLogger("madsim_tpu.stream")
+
 from .. import kinds as _kinds
 from ..compile_cache import enable_compile_cache
 from ..ops import find_free_slot, pop_earliest
 from ..ops.coverage import (
     COV_BAND_AMNESIA,
     COV_BAND_DUP,
+    COV_BUFFER_DEFAULT,
     COV_SLOTS_LOG2_DEFAULT,
     cov_band,
     cov_fold,
+    cov_push,
     cov_slot,
     empty_cov_map,
 )
 from ..ops.pallas_pop import (
     HAVE_PALLAS,
+    cov_flush_batch,
     pop_earliest_batch,
     pop_gather_batch,
     step_megakernel,
@@ -457,6 +463,20 @@ class EngineConfig:
     # layout, never result-affecting; excluded from corpus configs
     # like the other coverage knobs.
     cov_band_bits_min: int = 0
+    # Per-lane coverage slot-buffer depth (flush-on-freeze buffered
+    # fold, r12): > 0 buffers each popped event's slot index in a tiny
+    # int32[cov_buffer] per-lane ring and folds the packed bit map only
+    # on a fixed segment cadence, at segment exit, and therefore at
+    # every freeze point — removing the per-event map RMW scatter that
+    # BENCH_r11 measured at -7.37% of step throughput. 0 = the
+    # unbuffered per-event scatter (the escape hatch / differential
+    # oracle; A/B-able via `bench-ab --gate coverage-unbuffered`).
+    # Final maps are bit-identical either way — OR is commutative and
+    # idempotent, and the executor's segment-exit flush runs
+    # unconditionally, so frozen lanes can never strand buffered slots.
+    # Host-side perf knob: excluded from corpus serialization with the
+    # other coverage knobs.
+    cov_buffer: int = COV_BUFFER_DEFAULT
     # Causal provenance (observability): every queued event and every
     # node carries a 32-bit provenance word — one bit per scheduled
     # fault slot (bits 30/31: strict-restart wipes / duplicate
@@ -533,7 +553,11 @@ class LaneState:
     nodes: Any
     ring: Any  # {} when trace_ring == 0, else dict of [R]/[R,P] arrays
     fr: Any  # {} unless flight_recorder: digest + checkpoint ring + metrics
-    cov: Any  # {} unless coverage: {"map": int32[2^cov_slots_log2 / 32] bit words}
+    # {} unless coverage: {"map": int32[2^cov_slots_log2 / 32] bit words};
+    # the buffered regime (cov_buffer > 0) adds {"buf": int32[cov_buffer]
+    # pending slot indices, "buf_n": int32 live-entry count} — flushed
+    # into "map" by run_segment's cadence/exit folds
+    cov: Any
 
 
 @struct.dataclass
@@ -749,6 +773,37 @@ class Engine:
             dup_possible=fp.allow_dup,
             torn_possible=fp.allow_torn,
         )
+        # Buffered-coverage flush cadence: a step appends at most
+        # `slots_per_step` slots (the popped event, plus the synthetic
+        # dup-band slot when Bernoulli duplicates can occur), so
+        # flushing every cov_buffer // slots_per_step segment
+        # iterations makes buffer overflow impossible by construction —
+        # no per-event overflow branch exists, because a masked
+        # fallback fold would put the map RMW right back into every
+        # step's program (the cost the buffer removes). Validated
+        # here, after _rng_layout, because slots_per_step needs
+        # layout.dup_active.
+        self._cov_slots_per_step = 2 if self._rng_layout.dup_active else 1
+        if config.cov_buffer < 0 or config.cov_buffer > 1024:
+            raise ValueError(
+                f"cov_buffer={config.cov_buffer!r} — 0 (unbuffered "
+                f"per-event fold) or a depth in "
+                f"[{self._cov_slots_per_step}, 1024]"
+            )
+        if config.coverage and 0 < config.cov_buffer < self._cov_slots_per_step:
+            raise ValueError(
+                f"cov_buffer={config.cov_buffer} is shallower than the "
+                f"{self._cov_slots_per_step} slots one step can append "
+                f"under this config (dup events add a synthetic band "
+                f"slot); use 0 for the unbuffered fold or >= "
+                f"{self._cov_slots_per_step}"
+            )
+        self._cov_buffered = bool(config.coverage and config.cov_buffer > 0)
+        self._cov_flush_every = (
+            config.cov_buffer // self._cov_slots_per_step
+            if self._cov_buffered
+            else 0
+        )
 
     # -- lane init -----------------------------------------------------------
 
@@ -955,10 +1010,18 @@ class Engine:
         )
 
     def _empty_cov(self):
-        """Fresh coverage state: a zeroed per-lane hit map."""
+        """Fresh coverage state: a zeroed per-lane hit map, plus — in
+        the buffered regime (cov_buffer > 0) — the per-lane slot buffer
+        and its live-entry count. Unbuffered keeps the map-only pytree,
+        so cov_buffer=0 states are leaf-for-leaf identical to the
+        pre-buffer layout."""
         if not self.config.coverage:
             return {}
-        return {"map": empty_cov_map(self.config.cov_slots_log2)}
+        cov = {"map": empty_cov_map(self.config.cov_slots_log2)}
+        if self._cov_buffered:
+            cov["buf"] = jnp.zeros((self.config.cov_buffer,), jnp.int32)
+            cov["buf_n"] = jnp.int32(0)
+        return cov
 
     def _empty_fr(self, eq_valid=None):
         """Fresh flight-recorder state: digest at its IV, empty
@@ -1662,8 +1725,20 @@ class Engine:
                 abs_word, ev_kind, ev_node, op_word, ctx, cfg.cov_slots_log2,
                 band_bits=self.cov_band_bits, band=band,
             )
-            # same condition as the trace ring / digest: popped events
-            cov = {"map": cov_fold(cov["map"], slot, live)}
+            # same condition as the trace ring / digest: popped events.
+            # Buffered regime (cov_buffer > 0): append the slot index to
+            # the tiny per-lane ring instead of scattering into the
+            # 2 KiB map — the map never appears in the step program;
+            # run_segment folds the buffer at the flush cadence, at
+            # segment exit, and therefore at every freeze point. OR is
+            # commutative + idempotent, so the final map is
+            # bit-identical to the per-event fold (the cov_buffer=0
+            # oracle; tests/test_coverage.py differentials).
+            if self._cov_buffered:
+                buf, buf_n = cov_push(cov["buf"], cov["buf_n"], slot, live)
+                cov = dict(cov, buf=buf, buf_n=buf_n)
+            else:
+                cov = {"map": cov_fold(cov["map"], slot, live)}
             if layout.dup_active:
                 # synthetic dup band: a step that enqueued >= 1 duplicate
                 # is its own scenario class (one extra word fold, only
@@ -1673,7 +1748,14 @@ class Engine:
                     cfg.cov_slots_log2, band_bits=self.cov_band_bits,
                     band=jnp.int32(COV_BAND_DUP),
                 )
-                cov = {"map": cov_fold(cov["map"], dup_slot, live & (n_dups > 0))}
+                dup_hit = live & (n_dups > 0)
+                if self._cov_buffered:
+                    buf, buf_n = cov_push(
+                        cov["buf"], cov["buf_n"], dup_slot, dup_hit
+                    )
+                    cov = dict(cov, buf=buf, buf_n=buf_n)
+                else:
+                    cov = {"map": cov_fold(cov["map"], dup_slot, dup_hit)}
 
         # -- invariants / termination ---------------------------------------
         ok, code = m.invariant(nodes, new_now)
@@ -1807,9 +1889,35 @@ class Engine:
             cov=final.cov,
         )
 
+    def _cov_flush_batch(self, state: LaneState) -> LaneState:
+        """Fold every lane's buffered coverage slots into its packed
+        bit map and reset the buffer counts. Bit-identical to having
+        folded each slot at its original event (OR commutes and is
+        idempotent); the buffer contents are left in place — only the
+        live count resets, and cov_push masks dead entries to 0 anyway,
+        so stale tails stay deterministic for check_determinism."""
+        cov = state.cov
+        new_map = cov_flush_batch(
+            cov["map"], cov["buf"], cov["buf_n"],
+            use_pallas=self.use_pallas_pop,
+            interpret=self._pallas_interpret,
+        )
+        zeros = jnp.zeros_like(cov["buf_n"])
+        return state.replace(cov=dict(cov, map=new_map, buf_n=zeros))
+
     def run_segment(self, state: LaneState, segment_steps: int) -> LaneState:
         """Advance the batch at most `segment_steps` events per lane (stops
-        early if every lane finishes). Building block for streaming."""
+        early if every lane finishes). Building block for streaming.
+
+        In the buffered-coverage regime the body folds the slot buffers
+        into the bit maps every `_cov_flush_every` iterations (a SCALAR
+        cadence predicate — the untaken branch costs nothing), and an
+        unconditional exit flush runs after the loop. The exit flush is
+        what makes flush-on-freeze safe with no per-lane bookkeeping: a
+        lane frozen mid-segment (done/failed; step_batch's `active`
+        mask) simply stops appending, and whatever its buffer holds is
+        folded here before any consumer — run_batch's harvest, the
+        stream's cov-map OR — can observe the map."""
 
         def cond(carry):
             s, it = carry
@@ -1820,9 +1928,33 @@ class Engine:
 
         def body(carry):
             s, it = carry
-            return self.step_batch(s), it + 1
+            s, it = self.step_batch(s), it + 1
+            if self._cov_buffered:
+                # cadence flush: overflow is impossible by construction
+                # (cov_buffer // slots_per_step iterations fill at most
+                # cov_buffer entries), so no per-event overflow branch
+                # ever touches the map. The predicate is a scalar, so
+                # only the taken branch executes.
+                s = lax.cond(
+                    it % self._cov_flush_every == 0,
+                    self._cov_flush_batch,
+                    lambda x: x,
+                    s,
+                )
+            return s, it
 
         final, _ = lax.while_loop(cond, body, (state, jnp.int32(0)))
+        if self._cov_buffered:
+            # segment-exit flush — skipped only when NO lane holds a
+            # buffered slot (e.g. segment_steps is a multiple of the
+            # cadence, so the last body flush already drained; or every
+            # lane froze before appending), which the any-reduce below
+            # detects. cov-buffer-fold in srules.COLLECTIVES.
+            # madsim: collective(cov-buffer-fold, reduce=or)
+            pending = jnp.any(final.cov["buf_n"] > 0)
+            final = lax.cond(
+                pending, self._cov_flush_batch, lambda x: x, final
+            )
         return final
 
     def _stream_fns(
@@ -1833,6 +1965,7 @@ class Engine:
         batch: int,
         donate: bool = True,
         segments_per_dispatch: int = 8,
+        aot: bool = False,
     ):
         """Jitted building blocks for run_stream, cached per shape-affecting
         params (fresh jit wrappers would recompile on every call).
@@ -1859,8 +1992,14 @@ class Engine:
         cache = getattr(self, "_stream_cache", None)
         if cache is None:
             cache = self._stream_cache = {}
+        # scan-over-segments (r12): the supersegment's fixed-count
+        # dispatch loop as lax.scan of a predicated segment body
+        # instead of lax.while_loop. MADSIM_TPU_STREAM_SCAN=0 keeps the
+        # while form A/B-able for one release; both execute the
+        # bit-identical segment sequence (see supersegment below).
+        use_scan = os.environ.get("MADSIM_TPU_STREAM_SCAN", "1") != "0"
         key = (segment_steps, max_steps, ring_capacity, batch, donate,
-               segments_per_dispatch)
+               segments_per_dispatch, use_scan, aot)
         if key in cache:
             return cache[key]
 
@@ -2059,16 +2198,43 @@ class Engine:
             )
             return new.replace(counters=_counters(new))
 
-        def supersegment(c: StreamCarry, need) -> StreamCarry:
+        def _dispatch_go(cc: StreamCarry, need):
             # The host loop's between-segment checks, moved on-device:
             # stop at the completion target (same crossing as the r5
             # per-segment driver — bit-identical executed-segment
             # sequence for any dispatch depth), park on ring pressure
             # (host must drain), else advance another whole segment.
+            pressure = (cc.fail_count > drain_mark) | (cc.ab_count > drain_mark)
+            return (cc.completed < need) & ~pressure
+
+        def supersegment(c: StreamCarry, need) -> StreamCarry:
+            if use_scan:
+                # scan-over-segments: a fixed segments_per_dispatch trip
+                # count with the go-predicate as a per-iteration
+                # lax.cond (scalar, so the parked branch executes
+                # nothing). Bit-identical to the while form: completed
+                # only grows and the rings only fill WITHIN a dispatch
+                # (drains happen on the host between dispatches), so
+                # the go-predicate is monotone — once it flips false it
+                # stays false, and the executed segment prefix is
+                # exactly the while_loop's.
+                def body(cc, _):
+                    cc = lax.cond(
+                        _dispatch_go(cc, need),
+                        _segment_impl,
+                        lambda x: x,
+                        cc,
+                    )
+                    return cc, None
+
+                final, _ = lax.scan(
+                    body, c, None, length=segments_per_dispatch
+                )
+                return final
+
             def cond(carry):
                 cc, it = carry
-                pressure = (cc.fail_count > drain_mark) | (cc.ab_count > drain_mark)
-                return (it < segments_per_dispatch) & (cc.completed < need) & ~pressure
+                return (it < segments_per_dispatch) & _dispatch_go(cc, need)
 
             def body(carry):
                 cc, it = carry
@@ -2088,8 +2254,220 @@ class Engine:
             jax.jit(supersegment, **donate_kw),
             jax.jit(reset_rings, **donate_kw),
         )
+        if aot:
+            fns = self._aot_stream_fns(
+                fns,
+                (init_carry, _segment_impl, supersegment, reset_rings),
+                donate_kw=donate_kw,
+                batch=batch,
+                fns_key=key,
+            )
         cache[key] = fns
         return fns
+
+    def _aot_stream_fns(self, jitted, raw, *, donate_kw, batch, fns_key):
+        """AOT-serialize the streaming fns via `jax.export`, keyed so a
+        warm fleet worker deserializes the traced+lowered StableHLO
+        instead of re-tracing Python (the r11 flagship warm start was
+        18.2 s, TRACE-dominated — the persistent XLA cache already
+        covers the compile half).
+
+        Key = `compile_cache.cache_subkey` (jax version / stream / lane
+        shape) + a sha1 over the package source fingerprint, the full
+        EngineConfig, the machine identity and scalar params, the
+        stream-fns shape tuple, the kernel-backend flags and the jax
+        backend — everything that can change the traced program. A key
+        that misses (or a corrupt/stale artifact) degrades to a live
+        trace which is then exported and saved for the next worker.
+        Every path EXECUTES through `jax.jit(exported.call)` — never
+        mixing "exported on warm, plain jit on cold" — so both paths
+        compile the same exported-call HLO and share one persistent
+        XLA cache entry.
+
+        Only called with `mesh is None` (run_stream gates it): an
+        exported module is traced with unsharded avals, and replaying
+        it under explicit shardings would silently drop the layout
+        contract."""
+        import hashlib
+        import time
+
+        from jax import export as jexport
+
+        from .. import compile_cache as _cc
+
+        m = self.machine
+        scalars = {
+            k: v
+            for k, v in sorted(vars(m).items())
+            if isinstance(v, (int, float, str, bool))
+        }
+        ident = "|".join(
+            [
+                _cc.source_fingerprint(),
+                repr(self.config),
+                f"{type(m).__module__}.{type(m).__qualname__}",
+                repr(scalars),
+                repr(fns_key),
+                repr(
+                    (
+                        self.use_pallas_pop,
+                        self.use_megakernel,
+                        self._pallas_interpret,
+                    )
+                ),
+                jax.default_backend(),
+            ]
+        )
+        subkey = (
+            _cc.cache_subkey(rng_stream=self.config.rng_stream, lanes=batch)
+            + "-"
+            + hashlib.sha1(ident.encode()).hexdigest()[:16]
+        )
+        names = ("init_carry", "segment", "supersegment", "reset_rings")
+        seeds_aval = jax.ShapeDtypeStruct((batch,), jnp.uint32)
+        carry_aval = jax.eval_shape(jitted[0], seeds_aval)
+        need_aval = jax.ShapeDtypeStruct((), jnp.int32)
+        avals = {
+            "init_carry": (seeds_aval,),
+            "segment": (carry_aval,),
+            "supersegment": (carry_aval, need_aval),
+            "reset_rings": (carry_aval,),
+        }
+        # jax.export cannot serialize custom pytree nodes (the flax
+        # struct dataclasses and model states riding the carry), so
+        # each fn is exported over FLAT LEAF LISTS and the pytree
+        # structure is rebuilt at the call boundary. The treedefs come
+        # from a local eval_shape — abstract tracing, milliseconds —
+        # never from the artifact, so structure drift between writer
+        # and reader surfaces as a leaf-count/shape mismatch (a loud
+        # error), not a misdecoded tree.
+        out_tree = jax.tree.structure(carry_aval)
+
+        def _make_flat(rfn, in_tree):
+            def flat_fn(*leaves):
+                args = jax.tree.unflatten(in_tree, list(leaves))
+                return tuple(jax.tree.leaves(rfn(*args)))
+
+            return flat_fn
+
+        def _make_wrapped(exp):
+            def from_export(*args):
+                flat = exp.call(*jax.tree.leaves(args))
+                return jax.tree.unflatten(out_tree, list(flat))
+
+            return from_export
+
+        timings = self.compile_timings = {
+            "trace_s": 0.0,
+            "aot_hits": [],
+            "aot_misses": [],
+            "aot_key": subkey,
+        }
+        out = []
+        for name, jfn, rfn in zip(names, jitted, raw):
+            kw = {} if name == "init_carry" else donate_kw
+            in_leaves, in_tree = jax.tree.flatten(avals[name])
+            exp = None
+            blob = _cc.load_aot(subkey, name)
+            if blob is not None:
+                try:
+                    exp = jexport.deserialize(bytearray(blob))
+                    timings["aot_hits"].append(name)
+                except Exception as e:
+                    _stream_log.warning(
+                        "corrupt AOT artifact %s/%s (%s); re-tracing",
+                        subkey, name, e,
+                    )
+                    exp = None
+            if exp is None:
+                t0 = time.perf_counter()  # madsim: allow(D001) — host-side timing
+                try:
+                    exp = jexport.export(jax.jit(_make_flat(rfn, in_tree)))(
+                        *in_leaves
+                    )
+                    blob = bytes(exp.serialize())
+                except Exception as e:
+                    _stream_log.warning(
+                        "jax.export failed for %s (%s); falling back to "
+                        "plain jit for this process", name, e,
+                    )
+                    out.append(jfn)
+                    continue
+                timings["trace_s"] += time.perf_counter() - t0  # madsim: allow(D001)
+                timings["aot_misses"].append(name)
+                _cc.save_aot(subkey, name, blob)
+            out.append(jax.jit(_make_wrapped(exp), **kw))
+        return tuple(out)
+
+    def measure_stream_trace(
+        self,
+        batch: int,
+        segment_steps: int = 256,
+        max_steps: int = 10_000,
+        segments_per_dispatch: int = 8,
+        donate: Optional[bool] = None,
+    ) -> float:
+        """Time the TRACE+LOWER phase of the streaming supersegment at
+        this shape — the component of a cold compile that `jax.jit`
+        re-pays every process even when the persistent XLA cache
+        serves the executable. bench.py reports it as `trace_s` next
+        to compile_s_cold/warm so TRACE- vs XLA-dominance is a
+        recorded number. `jitted.lower()` always re-traces, so calling
+        this AFTER the timed cold run leaves that measurement
+        untouched."""
+        import time
+
+        if donate is None:
+            donate = os.environ.get("MADSIM_TPU_STREAM_DONATE", "1") not in ("", "0")
+        init_carry, _segment, supersegment, _reset = self._stream_fns(
+            segment_steps, max_steps, 2 * batch, batch,
+            donate=donate, segments_per_dispatch=segments_per_dispatch,
+        )
+        seeds_aval = jax.ShapeDtypeStruct((batch,), jnp.uint32)
+        carry_aval = jax.eval_shape(init_carry, seeds_aval)
+        t0 = time.perf_counter()  # madsim: allow(D001) — host-side timing
+        supersegment.lower(carry_aval, jax.ShapeDtypeStruct((), jnp.int32))
+        return time.perf_counter() - t0  # madsim: allow(D001)
+
+    def compile_stream(
+        self,
+        batch: int,
+        segment_steps: int = 256,
+        max_steps: int = 10_000,
+        segments_per_dispatch: int = 8,
+        donate: Optional[bool] = None,
+    ) -> None:
+        """Force-compile the streaming quartet at this shape WITHOUT
+        executing a stream: build (or fetch) the jitted fns exactly as
+        the unsharded `run_stream` would — same `_stream_fns` cache
+        key, same AOT gating — then `.lower().compile()` each at its
+        declared avals. This is a worker's start cost in isolation:
+        trace (or AOT deserialize) + XLA compile (or persistent-cache
+        hit), with zero device execution mixed in. bench.py times this
+        as compile_s_cold / compile_s_warm; the old run(1)-based timing
+        conflated the start cost with the FIRST DISPATCH's execution,
+        which at the 8192-lane flagship shape on the 1-core CPU
+        reference box is ~17 s of fixed-shape compute — drowning the
+        ~1 s the warm start actually pays."""
+        from ..compile_cache import aot_enabled
+
+        if donate is None:
+            donate = os.environ.get("MADSIM_TPU_STREAM_DONATE", "1") not in ("", "0")
+        init_carry, segment, supersegment, reset_rings = self._stream_fns(
+            segment_steps, max_steps, 2 * batch, batch,
+            donate=donate, segments_per_dispatch=segments_per_dispatch,
+            aot=aot_enabled(),
+        )
+        seeds_aval = jax.ShapeDtypeStruct((batch,), jnp.uint32)
+        carry_aval = jax.eval_shape(init_carry, seeds_aval)
+        need_aval = jax.ShapeDtypeStruct((), jnp.int32)
+        for fn, avals in (
+            (init_carry, (seeds_aval,)),
+            (segment, (carry_aval,)),
+            (supersegment, (carry_aval, need_aval)),
+            (reset_rings, (carry_aval,)),
+        ):
+            fn.lower(*avals).compile()
 
     def run_stream(self, n_seeds: int, **kwargs):
         """See `_run_stream_impl` (the real docstring). This wrapper
@@ -2181,9 +2559,16 @@ class Engine:
         # rings can never overflow no matter how many dispatches are in
         # flight.
         ring_capacity = 2 * batch
+        # AOT deserialization of the streaming fns ($MADSIM_TPU_AOT_
+        # CACHE, compile_cache.aot_enabled): gated to the unsharded
+        # path — an exported module is traced without shardings, and
+        # replaying it under a mesh would drop the layout contract.
+        from ..compile_cache import aot_enabled
+
         init_carry, segment, supersegment, reset_rings = self._stream_fns(
             segment_steps, max_steps, ring_capacity, batch,
             donate=donate, segments_per_dispatch=segments_per_dispatch,
+            aot=mesh is None and aot_enabled(),
         )
 
         seeds = jnp.arange(seed_start, seed_start + batch, dtype=jnp.uint32)

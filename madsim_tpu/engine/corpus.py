@@ -45,7 +45,9 @@ def config_to_dict(cfg: EngineConfig) -> dict:
         d.pop(k, None)
     # scenario coverage is the same class of gate: write-only telemetry,
     # asserted bit-identical — entries must replay with or without it
-    for k in ("coverage", "cov_slots_log2", "cov_band_bits_min"):
+    # (cov_buffer is the buffered-fold perf knob: final maps are
+    # bit-identical to the per-event path, so it never enters an entry)
+    for k in ("coverage", "cov_slots_log2", "cov_band_bits_min", "cov_buffer"):
         d.pop(k, None)
     # causal provenance too: lineage words never feed back into results,
     # and `why` re-enables the gate itself at replay time

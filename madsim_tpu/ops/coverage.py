@@ -167,6 +167,50 @@ def cov_fold(cov_map: jax.Array, slot, hit) -> jax.Array:
     return cov_map.at[w].set(cov_map[w] | bit)
 
 
+# Default per-lane slot-buffer depth for the flush-on-freeze buffered
+# fold (EngineConfig.cov_buffer; 0 = the unbuffered per-event scatter
+# above). BENCH_r11 measured the per-event map RMW at -7.37% of step
+# throughput: the scatter's operand is the whole [lanes, words] map, so
+# XLA touches 2 KiB/lane every step to set one bit. Buffering the slot
+# indices in a tiny int32[C] per-lane ring and folding only at the
+# flush cadence / segment exit removes the map from the per-event
+# program entirely — the step writes one 4-byte buffer entry instead.
+# 16 entries = 64 B/lane, deep enough that the flush cadence (every
+# C // slots_per_step iterations) stays a cheap segment-level event.
+COV_BUFFER_DEFAULT = 16
+
+
+def cov_push(buf: jax.Array, n: jax.Array, slot, hit):
+    """Append `slot` to the per-lane buffer when `hit`, else write a
+    masked 0 into the CURRENT tail position (same write either way —
+    no divergent program). `n` counts live entries; misses don't
+    advance it, so the occupied prefix [0, n) holds exactly the hit
+    slots in event order. The caller guarantees n < len(buf) by
+    flushing on a fixed cadence (engine.core.run_segment), so the
+    clip never actually redirects a write — it is defensive bounds
+    hygiene for the scatter, not an overflow policy."""
+    hit_i = hit.astype(jnp.int32)
+    pos = jnp.clip(n, 0, buf.shape[0] - 1)
+    slot = jnp.asarray(slot).astype(jnp.int32)
+    return buf.at[pos].set(slot * hit_i), n + hit_i
+
+
+def cov_flush(cov_map: jax.Array, buf: jax.Array, n: jax.Array) -> jax.Array:
+    """Fold the buffered slot prefix [0, n) into the packed bit map.
+
+    An unrolled sequence of `cov_fold`s with hit = (i < n): OR is
+    commutative and idempotent, so the result is bit-identical to
+    having folded each slot at its original event — and a sequential
+    fold (not one wide scatter) is what keeps duplicate words correct:
+    a single `.at[ws].set(...)` with repeated word indices would keep
+    only one of the colliding ORs. len(buf) is a small static constant
+    (EngineConfig.cov_buffer), so the unroll is C tiny fused ops, paid
+    once per flush instead of per event."""
+    for i in range(buf.shape[0]):
+        cov_map = cov_fold(cov_map, buf[i], i < n)
+    return cov_map
+
+
 def empty_cov_map(slots_log2: int) -> jax.Array:
     """Zeroed per-lane hit map: int32[(2^slots_log2)/32] packed words
     (slot s lives in word s >> 5, bit s & 31)."""

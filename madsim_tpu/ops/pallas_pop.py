@@ -374,6 +374,72 @@ def step_megakernel(
     )
 
 
+# -- buffered-coverage flush kernel (r12) ------------------------------------
+#
+# The flush-on-freeze buffered coverage path (EngineConfig.cov_buffer)
+# moved the per-event map scatter out of the step; what remains is a
+# per-segment fold of each lane's int32[C] slot buffer into its
+# int32[W] packed bit map. The coverage SLOT HASH still cannot join the
+# megakernel (it needs the POST-step model projection — see the module
+# docstring), so the Pallas treatment lands here instead: one VMEM pass
+# per lane block ORing every buffered entry's one-hot word into the
+# map. One-hot-over-words is the same trick the gather kernels use in
+# reverse, and OR is order-independent, so the kernel is bit-identical
+# to the sequential `coverage.cov_flush` oracle by construction
+# (asserted over the C/W grid in tests/test_pallas.py).
+
+
+def _make_cov_flush_kernel(n_entries: int):
+    def kernel(map_ref, buf_ref, n_ref, out_ref):
+        m = map_ref[...]
+        buf = buf_ref[...]
+        n = n_ref[...]  # [LB, 1] live-entry counts
+        cols = jax.lax.broadcasted_iota(jnp.int32, m.shape, dimension=1)
+        for i in range(n_entries):
+            slot = buf[:, i : i + 1]
+            hit = (jnp.int32(i) < n).astype(jnp.int32)
+            bit = (jnp.int32(1) << (slot & 31)) * hit
+            m = m | jnp.where(cols == (slot >> 5), bit, 0)
+        out_ref[...] = m
+
+    return kernel
+
+
+def cov_flush_pallas(cov_map, buf, n, interpret: bool = False):
+    """Fold [L, C] buffered slot indices (live prefix per `n[L]`) into
+    the [L, W] packed bit maps in one VMEM pass per lane block."""
+    lanes, w = cov_map.shape
+    c = buf.shape[1]
+    ins, padded = _pad_lanes(
+        [cov_map, buf, n[:, None].astype(jnp.int32)], lanes
+    )
+    grid = (padded // LANE_BLOCK,)
+    out = pl.pallas_call(
+        _make_cov_flush_kernel(c),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((LANE_BLOCK, w), lambda i: (i, 0)),
+            pl.BlockSpec((LANE_BLOCK, c), lambda i: (i, 0)),
+            pl.BlockSpec((LANE_BLOCK, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((LANE_BLOCK, w), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((padded, w), jnp.int32),
+        interpret=interpret,
+    )(*ins)
+    return out[:lanes]
+
+
+def cov_flush_batch(cov_map, buf, n, use_pallas: bool = False, interpret: bool = False):
+    """Batched buffer→map fold: the Pallas VMEM kernel, or the vmapped
+    sequential `coverage.cov_flush` reference (the bit-identity
+    oracle)."""
+    if use_pallas and HAVE_PALLAS:
+        return cov_flush_pallas(cov_map, buf, n, interpret=interpret)
+    from .coverage import cov_flush
+
+    return jax.vmap(cov_flush)(cov_map, buf, n)
+
+
 def pop_earliest_batch(eq_time, eq_seq, eq_valid, use_pallas: bool = False, interpret: bool = False):
     """Reference implementation (vmapped XLA) or the fused Pallas kernel."""
     if use_pallas and HAVE_PALLAS:

@@ -113,6 +113,7 @@ def make_record(
     reps: Optional[List[float]] = None,
     compile_s: Optional[float] = None,
     compile_s_warm: Optional[float] = None,
+    trace_s: Optional[float] = None,
     spread_pct: Optional[float] = None,
     host_load1: Optional[float] = None,
     step_cost: Optional[dict] = None,
@@ -130,6 +131,10 @@ def make_record(
         # capture ran without a cache — no warm path existed)
         "compile_s": compile_s,
         "compile_s_warm": compile_s_warm,
+        # trace_s = the pure abstract-trace share of a compile (what a
+        # warm start pays even when every XLA executable deserializes;
+        # what the AOT supersegment path removes)
+        "trace_s": trace_s,
         "spread_pct": spread_pct,
         "host_load1": host_load1,
         "step_cost": step_cost,

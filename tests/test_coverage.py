@@ -124,6 +124,61 @@ def test_stream_harvest_equals_batch_or(base_run):
     assert cov["fraction"] == round(cov["slots_hit"] / (1 << 10), 6)
 
 
+def test_buffered_fold_differential_oracle():
+    """The r12 flush-on-freeze buffered fold vs the per-event scatter
+    (the `cov_buffer=0` escape hatch): final maps and every simulation
+    result bit-identical under the FULL 11-kind chaos palette with
+    recorder + coverage + provenance all riding the step. max_steps is
+    prime, so it is never a multiple of the compiled flush cadence —
+    the final fold is forced through the segment-exit flush — and the
+    horizon lets lanes freeze (done) mid-run, so flush-on-freeze is
+    what stands between their buffered tails and silent slot loss."""
+    full = dataclasses.replace(
+        BASE,
+        rng_stream=3,
+        queue_capacity=96,
+        packet_loss_rate=0.01,
+        flight_recorder=True,
+        fr_digest_every=64,
+        fr_digest_ring=4,
+        cov_slots_log2=12,
+        provenance=True,
+        faults=dataclasses.replace(
+            BASE.faults,
+            n_faults=3,
+            allow_dir_clog=True, allow_group=True, allow_storm=True,
+            allow_delay=True, allow_pause=True, allow_skew=True,
+            allow_dup=True, allow_torn=True, allow_heal_asym=True,
+            strict_restart=True,
+        ),
+    )
+    seeds = jnp.arange(16, dtype=jnp.uint32)
+    eng_buf = Engine(_machine(), full)
+    assert eng_buf._cov_buffered and eng_buf._cov_flush_every > 0
+    assert 877 % eng_buf._cov_flush_every != 0
+    r_buf = jax.jit(lambda s: eng_buf.run_batch(s, 877))(seeds)
+    eng_evt = Engine(_machine(), dataclasses.replace(full, cov_buffer=0))
+    assert not eng_evt._cov_buffered
+    r_evt = jax.jit(lambda s: eng_evt.run_batch(s, 877))(seeds)
+    # the scenario is the one claimed: lanes actually froze mid-run
+    # (some done before the step budget) while others kept appending
+    assert bool(r_buf.done.any())
+    # differential identity: the map AND everything else
+    assert bool((r_buf.cov["map"] == r_evt.cov["map"]).all())
+    for name in ("done", "failed", "fail_code", "now_us", "steps", "msg_count"):
+        assert bool((getattr(r_buf, name) == getattr(r_evt, name)).all()), name
+    assert bool((r_buf.fail_prov == r_evt.fail_prov).all())
+    for k in r_evt.fr:
+        assert bool((r_buf.fr[k] == r_evt.fr[k]).all()), k
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: bool((a == b).all()), r_buf.summary, r_evt.summary
+    ))
+    # the exit flush drained every buffer before the harvest
+    assert int(np.asarray(r_buf.cov["buf_n"]).max()) == 0
+    # and the escape hatch carries no buffer leaves at all
+    assert set(r_evt.cov) == {"map"}
+
+
 def test_plateau_detector_policy():
     with pytest.raises(ValueError):
         PlateauDetector(0)

@@ -760,28 +760,36 @@ def test_s_real_executor_clean_then_mutated(s_core_repo):
     needle = (
         "        def body(carry):\n"
         "            s, it = carry\n"
-        "            return self.step_batch(s), it + 1"
+        "            s, it = self.step_batch(s), it + 1"
     )
     assert needle in src, "executor anchor moved; update this test"
     p.write_text(src.replace(needle, needle.replace(
-        "            return self.step_batch(s), it + 1",
+        "            s, it = self.step_batch(s), it + 1",
         "            _probe = jnp.sum(s.msg_count.astype(jnp.int32), axis=0)\n"
-        "            return self.step_batch(s), it + 1",
+        "            s, it = self.step_batch(s), it + 1",
     )))
     found = srules.check_model(projectmodel.build_model(str(s_core_repo)))
     s001 = [f for f in found if f.rule == "S001"]
     assert s001 and "chain: Engine.run_segment" in s001[0].message
     assert any(f.rule == "S004" for f in found)
 
-    ann = "# madsim: collective(segment-done-any, reduce=any)"
-    assert ann in src, "annotation anchor moved; update this test"
-    p.write_text(src.replace(ann, "# (stripped)"))
-    found = srules.check_model(projectmodel.build_model(str(s_core_repo)))
-    assert any(f.rule == "S001" and f.line > 0 for f in found)
-    assert any(
-        f.rule == "S001" and "segment-done-any" in f.message and f.line == 0
-        for f in found
-    )
+    # stripping either designed collective's annotation — the while-cond
+    # done-any or the r12 segment-exit coverage fold — fires S001 at the
+    # now-undeclared op plus the stale-registry-row error for its name
+    for ann, reg_name in (
+        ("# madsim: collective(segment-done-any, reduce=any)",
+         "segment-done-any"),
+        ("# madsim: collective(cov-buffer-fold, reduce=or)",
+         "cov-buffer-fold"),
+    ):
+        assert ann in src, "annotation anchor moved; update this test"
+        p.write_text(src.replace(ann, "# (stripped)"))
+        found = srules.check_model(projectmodel.build_model(str(s_core_repo)))
+        assert any(f.rule == "S001" and f.line > 0 for f in found)
+        assert any(
+            f.rule == "S001" and reg_name in f.message and f.line == 0
+            for f in found
+        )
 
 
 def test_s_head_is_clean(repo_model):

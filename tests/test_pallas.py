@@ -243,3 +243,39 @@ def test_step_megakernel_without_digest_and_unaligned():
     for xv, pv in zip(xpop, ppop):
         assert xv.tolist() == pv.tolist()
     assert xw.tolist() == pw.tolist()
+
+
+@pytest.mark.parametrize("slots_log2", [7, 10])
+@pytest.mark.parametrize("c", [4, 16])
+def test_cov_flush_matches_sequential_oracle(slots_log2, c):
+    """The VMEM coverage-flush kernel vs the vmapped sequential
+    `coverage.cov_flush` oracle, bit-for-bit over the (map width,
+    buffer depth) grid. The random buffers deliberately carry duplicate
+    slots AND duplicate words within one buffer — the case a wide
+    scatter would clobber (last-write-wins loses ORs); the kernel's
+    one-hot OR accumulation and the oracle's sequential fold must agree
+    exactly anyway. n spans 0 (nothing live), partial, and full."""
+    from madsim_tpu.ops.pallas_pop import cov_flush_batch, cov_flush_pallas
+
+    lanes = 37  # deliberately unaligned to LANE_BLOCK
+    w = (1 << slots_log2) // 32
+    key = jax.random.PRNGKey(slots_log2 * 100 + c)
+    k1, k2, k3 = jax.random.split(key, 3)
+    cov_map = jax.random.randint(
+        k1, (lanes, w), -(2**31), 2**31 - 1, dtype=jnp.int32
+    )
+    # small slot range forces duplicate slots/words inside one buffer
+    buf = jax.random.randint(k2, (lanes, c), 0, 1 << slots_log2, dtype=jnp.int32)
+    buf = buf.at[:, : c // 2].set(buf[:, 0:1])  # hard duplicates
+    n = jax.random.randint(k3, (lanes,), 0, c + 1, dtype=jnp.int32)
+    n = n.at[0].set(0).at[1].set(c)  # pin the empty and full extremes
+    oracle = cov_flush_batch(cov_map, buf, n, use_pallas=False)
+    kernel = cov_flush_pallas(cov_map, buf, n, interpret=True)
+    assert kernel.shape == (lanes, w)
+    assert oracle.tolist() == kernel.tolist()
+    # dead tails (i >= n) must never touch the map: a buffer of
+    # out-of-range garbage with n=0 leaves the map bit-identical
+    garbage = jnp.full((lanes, c), (1 << slots_log2) - 1, jnp.int32)
+    zero_n = jnp.zeros((lanes,), jnp.int32)
+    same = cov_flush_pallas(cov_map, garbage, zero_n, interpret=True)
+    assert same.tolist() == cov_map.tolist()

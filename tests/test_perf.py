@@ -519,3 +519,78 @@ def test_bench_reports_cold_and_warm_compile_keys():
         assert key in src, key
     assert "measure_warm_compile" in src
     assert "enable_compile_cache(" in src and "strict=True" in src
+
+
+def test_bench_reports_trace_s_and_cold_trace_mode():
+    """bench.py's r12 contract additions: trace_s emitted as its own
+    key (the pure abstract-trace share a warm worker pays even when
+    every XLA executable deserializes), measured via the engine's
+    post-cold re-lower, and the MADSIM_TPU_BENCH_COLD_TRACE env wires
+    through to measure_warm_compile's AOT-suspended mode (source pin —
+    the flagship bench is out of tier-1 budget; CI's bench step
+    asserts the live values)."""
+    import inspect
+
+    from madsim_tpu import compile_cache as cc
+
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert '"trace_s"' in src
+    assert "measure_stream_trace" in src
+    assert "MADSIM_TPU_BENCH_COLD_TRACE" in src
+    assert "cold_trace=cold_trace" in src
+    assert "cold_trace" in inspect.signature(cc.measure_warm_compile).parameters
+    # the coverage-unbuffered escape hatch stays A/B-able from the bench
+    assert "coverage_unbuffered" in src and "cov_buffer=0" in src
+
+
+def test_aot_warm_start_beats_cold_trace(tmp_path, monkeypatch):
+    """The AOT supersegment artifacts pay off: a rebuilt engine whose
+    stream fns DESERIALIZE (warm, artifacts allowed) must start faster
+    than the same rebuild forced to re-trace everything
+    (measure_warm_compile(cold_trace=True) suspends the artifact
+    cache). The persistent XLA executable cache backs BOTH rebuilds,
+    so the delta isolates exactly the trace-vs-deserialize gap the
+    flagship's sub-5s warm-start target rests on. Small echo shape:
+    the gap is structural, not scale-dependent."""
+    import jax
+
+    from madsim_tpu import compile_cache as cc
+    from madsim_tpu.engine import Engine, EngineConfig, FaultPlan
+    from madsim_tpu.models.echo import EchoMachine
+
+    monkeypatch.setenv("MADSIM_TPU_AOT_CACHE", str(tmp_path / "aot"))
+    if cc.active_compile_cache() is None:
+        cc.enable_compile_cache(str(tmp_path / "xla"))
+    cfg = EngineConfig(
+        horizon_us=1_000_000, queue_capacity=16,
+        faults=FaultPlan(n_faults=0, t_max_us=1),
+    )
+    built = []
+
+    def build_and_run():
+        eng = Engine(EchoMachine(), cfg)
+        eng.make_stream_runner(batch=16, segment_steps=64, max_steps=256)(8)
+        built.append(eng)
+
+    build_and_run()  # cold: traces, exports, persists the artifacts
+    arts = [f for _, _, fs in os.walk(str(tmp_path / "aot")) for f in fs]
+    assert any(f.endswith(".jaxexp") for f in arts), arts
+    cold_timings = built[-1].compile_timings
+    assert cold_timings["aot_misses"] and cold_timings["trace_s"] > 0
+
+    warm_aot = cc.measure_warm_compile(build_and_run)
+    aot_timings = built[-1].compile_timings
+    warm_trace = cc.measure_warm_compile(build_and_run, cold_trace=True)
+    assert warm_aot is not None and warm_trace is not None
+    # structural receipts first (timing asserts alone flake on a busy
+    # 1-core box): the warm rebuild hit every artifact and re-traced
+    # nothing; the cold_trace rebuild never even engaged the AOT layer
+    assert set(aot_timings["aot_hits"]) == {
+        "init_carry", "segment", "supersegment", "reset_rings"
+    }
+    assert not aot_timings["aot_misses"] and aot_timings["trace_s"] == 0.0
+    # the suspended rebuild bypassed the AOT layer entirely
+    assert getattr(built[-1], "compile_timings", None) is None
+    # and the payoff itself: deserialize beats re-trace
+    assert warm_aot < warm_trace, (warm_aot, warm_trace)
+    jax.clear_caches()
