@@ -451,6 +451,10 @@ def _main_impl() -> None:
         # of the comparability key: cache state never changes
         # steady-state throughput, only compile_s
         compile_cache=active_compile_cache() is not None,
+        # this harness drives the unsharded single-device stream; the
+        # mesh captures (benches/tpu_sweep.py --mesh) record their own
+        # device_count so neighbor search never crosses topologies
+        device_count=1,
     )
     budget = bench_history.neighbor_budget(hist_rows, seeds_per_sec, fingerprint)
     if budget is not None and not budget["within_5pct"]:

@@ -237,6 +237,11 @@ def _repro_line(args, seed) -> str:
         f"--fault-kinds {getattr(args, 'fault_kinds', 'pair,kill')} "
         f"--rng-stream {getattr(args, 'rng_stream', 2)} "
         + ("--strict-restart " if getattr(args, "strict_restart", False) else "")
+        + (
+            f"--devices {args.devices} "
+            if getattr(args, "devices", 0)
+            else ""
+        )
         + f"--max-steps {args.max_steps}"
     )
 
@@ -288,12 +293,26 @@ def _stream_kwargs(args) -> dict:
     """Pipelined-executor knobs shared by explore/hunt/bench (default:
     pipelined + donated; --no-pipeline restores the r5 per-segment
     driver, kept for one release)."""
-    return {
+    kw = {
         "pipelined": not getattr(args, "no_pipeline", False),
         "segments_per_dispatch": getattr(args, "segments_per_dispatch", 8),
         "dispatch_depth": getattr(args, "dispatch_depth", 4),
         "donate": not getattr(args, "no_donate", False),
     }
+    n = getattr(args, "devices", 0)
+    if n:
+        import jax
+
+        from .parallel import make_mesh
+
+        devs = jax.devices()
+        if n > len(devs):
+            raise SystemExit(
+                f"--devices {n}: only {len(devs)} devices visible (on CPU, "
+                f"set XLA_FLAGS=--xla_force_host_platform_device_count={n})"
+            )
+        kw["mesh"] = make_mesh(devs[:n])
+    return kw
 
 
 def _print_fr_stats(stats) -> None:
@@ -1834,6 +1853,16 @@ def main(argv=None) -> int:
             "copy-per-call behavior; results are bit-identical either way)",
         )
         p.add_argument(
+            "--devices", type=int, default=0, metavar="N",
+            help="span the hunt over the first N devices as one jitted "
+            "SPMD program (a 1-D 'batch' mesh; lane leaves sharded, "
+            "global leaves replicated). Results are byte-identical at "
+            "any N; batch must be a multiple of N. 0 = unsharded "
+            "single-device path (the default). On a CPU-only box, "
+            "force virtual devices with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=N",
+        )
+        p.add_argument(
             "--stop-on-plateau", type=int, default=0, metavar="N",
             help="with --coverage: stop the run early when N consecutive "
             "seed batches add zero new coverage slots (the saturation "
@@ -1882,6 +1911,12 @@ def main(argv=None) -> int:
     p = sub.add_parser("replay", help="bit-identical replay of one seed with trace")
     common(p)
     p.add_argument("--tail", type=int, default=30, help="print last N events (0=all)")
+    p.add_argument(
+        "--devices", type=int, default=0,
+        help="accepted for repro-line fidelity (hunts record the mesh "
+        "size they ran at); replay is single-lane and byte-identical "
+        "at any device count, so the value is recorded but unused",
+    )
     p.add_argument(
         "--diff-seed", type=int, default=None,
         help="also replay this seed and print where the two event "

@@ -126,7 +126,10 @@ COLLECTIVES: Dict[str, Collective] = {
         "or", ("segment",),
         "global coverage map fold: bitwise-or all-reduce of the packed "
         "[W] words per segment (the 'tiny all-reduces' the ROADMAP "
-        "names)",
+        "names). Executed as ops/coverage.cov_fold_words: shard-local "
+        "or-reduce, then a bit-unpacked bool-any cross-device combine "
+        "— integer or-all-reduce is unimplemented on the CPU collective "
+        "runtime the mesh path is CI-proven on; exact either way",
     ),
     "cov-buffer-fold": Collective(
         "or", ("step",),

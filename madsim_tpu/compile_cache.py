@@ -164,14 +164,24 @@ def cache_subkey(
     rng_stream: Optional[int] = None,
     lanes: Optional[int] = None,
     segment_steps: Optional[int] = None,
+    devices: Optional[int] = None,
     import_jax: bool = True,
 ) -> str:
     """A directory-name-safe warm-start key: (jax/jaxlib version, gate
-    tuple, stream version, shape key). Two processes with equal subkeys
-    compile byte-identical HLO for the streaming path, so priming one
-    warms the other; anything that changes the compiled step (a jax
-    upgrade, a gate flip, a new lane count) lands in its own
-    subdirectory instead of growing one stale shared pile forever.
+    tuple, stream version, shape key, device topology). Two processes
+    with equal subkeys compile byte-identical HLO for the streaming
+    path, so priming one warms the other; anything that changes the
+    compiled step (a jax upgrade, a gate flip, a new lane count, a
+    different mesh shape) lands in its own subdirectory instead of
+    growing one stale shared pile forever.
+
+    `devices` is the 1-D "batch" mesh size the program spans (1 =
+    unsharded). It is part of the key because a serialized AOT export
+    is topology-specific — a single-device export must never
+    deserialize into a mesh run and vice versa — and because the fleet
+    allocator's warm-compile grouping must keep a mesh job and a
+    single-device job in different groups (their compiled programs
+    share nothing).
 
     `gates` is the bench-style dict ({"rng_stream": 3, "coverage":
     True, ...}); bool values render as 0/1, the rest as-is. Unknown /
@@ -212,6 +222,8 @@ def cache_subkey(
         if segment_steps is not None:
             shape += f"x{segment_steps}"
         parts.append(shape)
+    if devices is not None:
+        parts.append(f"d{devices}")
     return re.sub(r"[^A-Za-z0-9._-]", "_", "-".join(parts))
 
 

@@ -50,6 +50,7 @@ from ..ops.coverage import (
     COV_SLOTS_LOG2_DEFAULT,
     cov_band,
     cov_fold,
+    cov_fold_words,
     cov_push,
     cov_slot,
     empty_cov_map,
@@ -1966,11 +1967,27 @@ class Engine:
         donate: bool = True,
         segments_per_dispatch: int = 8,
         aot: bool = False,
+        mesh=None,
     ):
         """Jitted building blocks for run_stream, cached per shape-affecting
         params (fresh jit wrappers would recompile on every call).
 
         Returns (init_carry, segment, supersegment, reset_rings).
+
+        With `mesh` (a 1-D "batch" mesh, parallel.make_mesh), the four
+        fns are jitted with EXPLICIT in/out_shardings derived from the
+        declared carry-axis table (`parallel.carry_shardings` over
+        `analysis.srules.CARRY_AXES`): every lane leaf pinned
+        `NamedSharding(mesh, P("batch"))`, every global leaf replicated
+        `P()` — one hunt spans all devices as a single jitted SPMD
+        program, donation preserved. The pinned layout is what places
+        the 17 registered collectives (srules.COLLECTIVES) at segment
+        boundaries: per-lane state never crosses devices inside the
+        per-event loop, because only the segment-level folds (refill
+        count/ranks, harvest-completed, ring appends, fr folds,
+        cov-map OR) read lane values into replicated leaves. `mesh` is
+        part of the fns cache key; `aot` and `mesh` are mutually
+        exclusive (exported modules are traced unsharded).
 
         `segment` / `supersegment` / `reset_rings` donate their
         StreamCarry argument when `donate` (the multi-MB lane state is
@@ -1998,8 +2015,16 @@ class Engine:
         # while form A/B-able for one release; both execute the
         # bit-identical segment sequence (see supersegment below).
         use_scan = os.environ.get("MADSIM_TPU_STREAM_SCAN", "1") != "0"
+        if aot and mesh is not None:
+            raise ValueError(
+                "AOT stream fns cannot serve a meshed run: jax.export "
+                "modules are traced with unsharded avals (run_stream "
+                "gates aot to mesh=None)"
+            )
+        # jax.sharding.Mesh hashes by (devices, axis names), so two
+        # calls with equal meshes share one quartet
         key = (segment_steps, max_steps, ring_capacity, batch, donate,
-               segments_per_dispatch, use_scan, aot)
+               segments_per_dispatch, use_scan, aot, mesh)
         if key in cache:
             return cache[key]
 
@@ -2174,9 +2199,10 @@ class Engine:
             # coverage to the live curve the host polls.
             cov_map = c.cov_map
             if self.config.coverage:
-                # madsim: collective(cov-map-or, reduce=or)
-                cov_map = cov_map | lax.reduce(
-                    state.cov["map"], jnp.int32(0), lax.bitwise_or, (0,)
+                # the cov-map-or collective lives in cov_fold_words
+                cov_map = cov_map | cov_fold_words(
+                    state.cov["map"],
+                    shards=mesh.size if mesh is not None else 1,
                 )
 
             new = StreamCarry(
@@ -2248,6 +2274,48 @@ class Engine:
             return new.replace(counters=_counters(new))
 
         donate_kw = {"donate_argnums": (0,)} if donate else {}
+        if mesh is not None:
+            # The mesh path: pin every leaf's placement at the jit
+            # boundary per the declared CARRY_AXES axis. Donation
+            # composes because in_shardings == out_shardings per leaf —
+            # XLA aliases each shard of the donated carry in place, the
+            # same zero-copy contract as the single-device path (T003
+            # guards the rebuild site). `need` is a replicated scalar.
+            from ..parallel import carry_shardings, seed_sharding
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            seeds_aval = jax.ShapeDtypeStruct((batch,), jnp.uint32)
+            cshard = carry_shardings(
+                mesh, jax.eval_shape(init_carry, seeds_aval)
+            )
+            repl = NamedSharding(mesh, PartitionSpec())
+            fns = (
+                jax.jit(
+                    init_carry,
+                    in_shardings=(seed_sharding(mesh),),
+                    out_shardings=cshard,
+                ),
+                jax.jit(
+                    _segment_impl,
+                    in_shardings=(cshard,),
+                    out_shardings=cshard,
+                    **donate_kw,
+                ),
+                jax.jit(
+                    supersegment,
+                    in_shardings=(cshard, repl),
+                    out_shardings=cshard,
+                    **donate_kw,
+                ),
+                jax.jit(
+                    reset_rings,
+                    in_shardings=(cshard,),
+                    out_shardings=cshard,
+                    **donate_kw,
+                ),
+            )
+            cache[key] = fns
+            return fns
         fns = (
             jax.jit(init_carry),
             jax.jit(_segment_impl, **donate_kw),
@@ -2273,7 +2341,7 @@ class Engine:
         covers the compile half).
 
         Key = `compile_cache.cache_subkey` (jax version / stream / lane
-        shape) + a sha1 over the package source fingerprint, the full
+        shape / device topology) + a sha1 over the package source fingerprint, the full
         EngineConfig, the machine identity and scalar params, the
         stream-fns shape tuple, the kernel-backend flags and the jax
         backend — everything that can change the traced program. A key
@@ -2318,8 +2386,15 @@ class Engine:
                 jax.default_backend(),
             ]
         )
+        # devices=1: an exported module is a SINGLE-device program by
+        # construction (this path is gated to mesh=None). The explicit
+        # topology in the key is the refusal contract — if meshed
+        # exports ever land, their d{mesh.size} artifacts can never be
+        # deserialized into an unsharded run or vice versa.
         subkey = (
-            _cc.cache_subkey(rng_stream=self.config.rng_stream, lanes=batch)
+            _cc.cache_subkey(
+                rng_stream=self.config.rng_stream, lanes=batch, devices=1
+            )
             + "-"
             + hashlib.sha1(ident.encode()).hexdigest()[:16]
         )
@@ -2527,9 +2602,24 @@ class Engine:
         [seed_start, seed_start + seeds_consumed) enters lanes, in order.
         Lanes exceeding `max_steps` events are abandoned and reported.
 
-        With `mesh`, the lane axis shards over the mesh's "seeds" axis and
-        every streaming op (init / segment / refill / ring append) stays
-        sharded by propagation — the 100k-seeds-over-a-pod configuration.
+        With `mesh` (a 1-D "batch" mesh, parallel.make_mesh), one hunt
+        spans all mesh devices as a single jitted SPMD program: every
+        StreamCarry leaf is PINNED at the jit boundary per its declared
+        `analysis.srules.CARRY_AXES` axis (lane leaves
+        `NamedSharding(mesh, P("batch"))`, global leaves replicated
+        P()), donation preserved. The 17 registered collectives
+        (srules.COLLECTIVES) become their declared all-reduce /
+        all-gather at segment boundaries — per-lane state never crosses
+        devices inside the per-event loop; the counters poll and the
+        coverage-OR are tiny cross-device reductions read at poll
+        cadence, and the ring drain gathers only failing lanes (the
+        rings are replicated leaves, so host reads stay O(polls +
+        drains), never O(devices)). Results are byte-identical to the
+        unsharded run at ANY device count: lane keys derive from the
+        seed alone (init_lane's per-seed PRNGKey), and every cross-lane
+        op computes over the full logical [L] axis under GSPMD — the
+        shard-count invariance tests/test_mesh.py pins. `batch` must be
+        a multiple of the mesh size.
 
         Returns {"completed", "failing": [(seed, code)...], "infra":
         [(seed, code)...] (infrastructure artifacts: OVERFLOW lanes —
@@ -2565,10 +2655,21 @@ class Engine:
         # replaying it under a mesh would drop the layout contract.
         from ..compile_cache import aot_enabled
 
+        if mesh is not None and mesh.size > 1 and (
+            self.use_pallas_pop or self.use_megakernel
+        ):
+            raise ValueError(
+                "meshed runs need the Pallas kernels off "
+                "(MADSIM_TPU_PALLAS_POP=0 / MADSIM_TPU_PALLAS_MEGAKERNEL=0, "
+                "or Engine(use_pallas_pop=False)): pallas_call blocks "
+                "GSPMD sharding propagation, so the lane-pinned layout "
+                "cannot cross it"
+            )
         init_carry, segment, supersegment, reset_rings = self._stream_fns(
             segment_steps, max_steps, ring_capacity, batch,
             donate=donate, segments_per_dispatch=segments_per_dispatch,
             aot=mesh is None and aot_enabled(),
+            mesh=mesh,
         )
 
         seeds = jnp.arange(seed_start, seed_start + batch, dtype=jnp.uint32)

@@ -142,6 +142,11 @@ SPEC_FIELDS = {
     # plateau; the (seed schedule, bias state) trail rides the job
     # checkpoint so resume/replacement replays are byte-identical
     "guided": (bool, False),
+    # span the hunt over the first N devices as one jitted SPMD
+    # program (the lane-axis mesh; 0 = unsharded). Part of the
+    # warm-compile grouping key: a mesh job and a single-device job
+    # compile different programs and must never share a group
+    "devices": (int, 0),
 }
 
 SEGMENT_STEPS = 384  # the streaming driver's pinned segment shape
@@ -184,6 +189,13 @@ def normalize_spec(spec: dict) -> dict:
     if out["guided"] and not out["coverage"]:
         raise ValueError(
             "guided needs coverage: the bias signal IS the live map"
+        )
+    if out["devices"] < 0:
+        raise ValueError("spec field 'devices' must be >= 0 (0 = unsharded)")
+    if out["devices"] and out["batch"] % out["devices"]:
+        raise ValueError(
+            f"batch ({out['batch']}) must be a multiple of devices "
+            f"({out['devices']}): lanes shard evenly over the mesh axis"
         )
     return out
 
@@ -246,6 +258,11 @@ def job_subkey(spec: dict) -> str:
         rng_stream=spec["rng_stream"],
         lanes=spec["batch"],
         segment_steps=SEGMENT_STEPS,
+        # mesh topology: a d8 job and an unsharded job compile disjoint
+        # programs, so the allocator must never pack them back-to-back.
+        # .get: docs persisted before the mesh rebuild have no field
+        # and stay in the unsharded group
+        devices=spec.get("devices") or None,
     )
 
 
@@ -276,6 +293,8 @@ def repro_cmd(spec: dict, *, batch_index: Optional[int] = None) -> str:
         f"--fault-kinds {spec['fault_kinds']}",
         f"--rng-stream {spec['rng_stream']}",
     ]
+    if spec.get("devices"):
+        parts.append(f"--devices {spec['devices']}")
     for flag, key in (("--strict-restart", "strict_restart"),
                       ("--coverage", "coverage"),
                       ("--provenance", "provenance"),
@@ -296,7 +315,11 @@ def engine_key(spec: dict) -> str:
         "fault_tmax", "fault_kinds", "rng_stream", "strict_restart",
         "coverage", "provenance", "flight_recorder", "batch",
     )
-    return json.dumps({f: spec[f] for f in fields}, sort_keys=True)
+    key = {f: spec[f] for f in fields}
+    # mesh size shapes the compiled program (explicit shardings are in
+    # the jit); .get keeps pre-mesh docs readable (unsharded group)
+    key["devices"] = spec.get("devices", 0)
+    return json.dumps(key, sort_keys=True)
 
 
 # -- the job document --------------------------------------------------------

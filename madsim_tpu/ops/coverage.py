@@ -211,6 +211,49 @@ def cov_flush(cov_map: jax.Array, buf: jax.Array, n: jax.Array) -> jax.Array:
     return cov_map
 
 
+def cov_fold_words(lane_maps: jax.Array, *, shards: int = 1) -> jax.Array:
+    """OR-fold the per-lane packed maps [L, W] into the global word
+    vector [W] — the `cov-map-or` collective of the stream harvest.
+
+    `shards=1` (the unsharded path) is the plain bitwise-or reduce —
+    byte-for-byte the historical fold, so single-device goldens are
+    untouched by construction.
+
+    `shards=mesh.size` (the mesh path, engine.core `_stream_fns`) is
+    the same fold restructured so every CROSS-DEVICE combine uses a
+    reduction computation the collective runtimes implement: an
+    integer bitwise-or AllReduce is UNIMPLEMENTED on the CPU backend
+    the mesh path is CI-proven on (and niche on others), while sum /
+    max / boolean-or are universal. Step 1 reduces shard-locally (a
+    split reshape keeps the lane axis's sharding on the leading factor,
+    so the [shards, L/shards, W] -> [shards, W] or-reduce never crosses
+    devices). Step 2 combines the per-shard partials bit-unpacked:
+    [shards, W, 32] bool `any` over the shard dim (a boolean-or
+    AllReduce), repacked by summing the disjoint single-bit words —
+    bits are disjoint so the sum IS the or, exactly. The intermediates
+    are [shards, W, 32] (a few KiB at any batch size): the restructured
+    fold costs O(devices * words), not O(lanes).
+
+    OR is associative/commutative/idempotent, so both forms compute
+    the identical [W] vector for any lane->shard split — the
+    shard-count-invariance argument tests/test_mesh.py pins."""
+    if shards <= 1:
+        # madsim: collective(cov-map-or, reduce=or)
+        return jax.lax.reduce(
+            lane_maps, jnp.int32(0), jax.lax.bitwise_or, (0,)
+        )
+    lanes, words = lane_maps.shape
+    # madsim: collective(cov-map-or, reduce=or) — the split reshape
+    # keeps the lane sharding on the leading factor; the shard-local
+    # or-reduce below it never crosses devices, the bool-any combine is
+    # the actual cross-chip leg
+    split = lane_maps.reshape(shards, lanes // shards, words)
+    part = jax.lax.reduce(split, jnp.int32(0), jax.lax.bitwise_or, (1,))
+    bits = jnp.arange(COV_WORD_BITS, dtype=jnp.int32)
+    hit = ((part[:, :, None] >> bits) & 1).any(axis=0)  # [W, 32] bool
+    return (hit.astype(jnp.int32) << bits).sum(axis=-1, dtype=jnp.int32)
+
+
 def empty_cov_map(slots_log2: int) -> jax.Array:
     """Zeroed per-lane hit map: int32[(2^slots_log2)/32] packed words
     (slot s lives in word s >> 5, bit s & 31)."""

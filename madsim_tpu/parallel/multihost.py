@@ -10,6 +10,14 @@ SPMD — each process computes only its lane shard, and only replicated
 reductions (completed counts, the fixed-capacity failing-seed ring)
 cross hosts.
 
+Since the lane-axis mesh rebuild, this module is a thin veneer: the
+engine's `run_stream(mesh=...)` path pins every StreamCarry leaf with
+explicit `carry_shardings` (parallel/__init__.py) derived from the
+declared CARRY_AXES table, and the 17 registered collectives
+(analysis/srules.py COLLECTIVES) are the only cross-device traffic.
+`run_stream_global` just builds the all-hosts mesh and delegates; the
+single-host and multi-host code paths are the same jitted program.
+
 Smoke-tested without TPU pods by running N processes on one machine with
 virtual CPU devices (tests/test_multihost.py: 2 processes x 4 devices,
 Gloo collectives) — the same code path a v5e multi-host job takes.
@@ -71,7 +79,8 @@ def initialize(
 
 
 def global_mesh():
-    """1-D "seeds" mesh over every device in the job (all hosts)."""
+    """1-D "batch" (lane-axis) mesh over every device in the job (all
+    hosts)."""
     return make_mesh(jax.devices())
 
 
@@ -146,10 +155,10 @@ def run_batch_global(
     replicated = NamedSharding(mesh, P())
 
     # The audited cross-lane baseline of this (pre-pipelined-executor)
-    # module — the worklist the lane-axis sharding rebuild starts from.
-    # Each op carries its S-rule collective annotation; the registry
-    # entries (analysis/srules.py COLLECTIVES, multihost-*) record the
-    # all-reduce each becomes under NamedSharding(mesh, P('batch')):
+    # module, kept as the simple one-shot alternative to the stream
+    # path. Each op carries its S-rule collective annotation; the
+    # registry entries (analysis/srules.py COLLECTIVES, multihost-*)
+    # record the all-reduce each is under NamedSharding(mesh, P('batch')):
     # the ranks scan + masked ring gather stay the ONLY cross-host
     # data movement (failing lanes only, never a full [L] all-gather),
     # and the completion count is already a psum by virtue of the

@@ -60,6 +60,7 @@ def env_fingerprint(
     segment_steps: Optional[int] = None,
     gates: Optional[dict] = None,
     compile_cache: Optional[bool] = None,
+    device_count: Optional[int] = None,
 ) -> dict:
     """The comparability fingerprint for one bench capture. Versions
     are read from the installed packages; `backend_platform` is the
@@ -67,7 +68,10 @@ def env_fingerprint(
     module stays jax-free. `compile_cache` records whether a
     persistent compilation cache backed the capture — context for its
     compile_s numbers, deliberately NOT part of the comparability key
-    (cache state never changes steady-state rate)."""
+    (cache state never changes steady-state rate). `device_count` is
+    the 1-D mesh size the stream spanned (None/1 = unsharded) and IS
+    part of the comparability key: neighbor comparison must never put
+    an 8-device rate next to a single-device one."""
     try:
         import jax
         import jaxlib
@@ -86,6 +90,7 @@ def env_fingerprint(
         "segment_steps": segment_steps,
         "gates": _norm_gates(gates),
         "compile_cache": compile_cache,
+        "device_count": device_count,
     }
 
 
@@ -255,6 +260,10 @@ def comparable(fp_a: Optional[dict], fp_b: Optional[dict]) -> bool:
         if fp_a.get(key) is None or fp_a.get(key) != fp_b.get(key):
             return False
     if fp_a.get("gates") is None or fp_a.get("gates") != fp_b.get("gates"):
+        return False
+    # topology isolation: a missing device_count is a pre-mesh (single-
+    # device) row, so legacy history stays comparable to fresh d1 rows
+    if (fp_a.get("device_count") or 1) != (fp_b.get("device_count") or 1):
         return False
     host_a, host_b = fp_a.get("host"), fp_b.get("host")
     if host_a is not None and host_b is not None and host_a != host_b:

@@ -210,7 +210,7 @@ def test_seed_sharding_over_mesh(raft_engine):
     seeds = shard_seeds(jnp.arange(8 * len(cpus), dtype=jnp.uint32), mesh)
     res = raft_engine.make_runner(max_steps=3000)(seeds)
     assert bool(res.done.all())
-    assert "seeds" in str(res.now_us.sharding)
+    assert "batch" in str(res.now_us.sharding)
     # sharded results equal unsharded results
     res1 = raft_engine.make_runner(max_steps=3000)(jnp.arange(8 * len(cpus), dtype=jnp.uint32))
     assert res.steps.tolist() == res1.steps.tolist()

@@ -221,6 +221,39 @@ def test_allocator_deadline_orders_within_priority():
     assert al.pick([late, soon]).id == soon.id
 
 
+def test_mesh_jobs_never_share_a_warm_compile_group():
+    """Mesh topology is part of the warm-compile grouping key (all
+    jax-free): a d8 job and an unsharded job compile disjoint programs,
+    so the allocator must treat them as different compile families —
+    and a pre-mesh spec (no `devices` field at all, docs persisted
+    before the rebuild) lands in the unsharded group."""
+    from madsim_tpu.fleet.store import job_subkey, repro_cmd
+
+    base = normalize_spec({"machine": "raft", "batch": 256})
+    meshed = normalize_spec({"machine": "raft", "batch": 256, "devices": 8})
+    legacy = dict(base)
+    del legacy["devices"]
+
+    k_base, k_mesh = job_subkey(base), job_subkey(meshed)
+    assert k_base != k_mesh and "d8" in k_mesh
+    assert job_subkey(legacy) == k_base  # pre-mesh docs: unsharded group
+    assert k_base.startswith("jax-unknown")  # computed without jax
+
+    # the allocator keys purely on subkey equality, so the two families
+    # round-robin within themselves and never interleave
+    a, b = _mk_job(1, k_base), _mk_job(2, k_mesh)
+    al = LaneAllocator()
+    assert al.pick([a, b]).id == a.id
+    assert al.pick([a, b]).id == a.id  # sticky until the group drains
+
+    # quarantine repro lines carry the topology; divisibility is
+    # refused at submit, not at the worker
+    assert "--devices 8" in repro_cmd(meshed)
+    assert "--devices" not in repro_cmd(base)
+    with pytest.raises(ValueError, match="multiple of devices"):
+        normalize_spec({"machine": "raft", "batch": 100, "devices": 8})
+
+
 # -- coverage-feedback scheduler ---------------------------------------------
 
 

@@ -728,6 +728,10 @@ _S_CORE_FILES = (
     "madsim_tpu/parallel/__init__.py",
     "madsim_tpu/parallel/multihost.py",
     "madsim_tpu/ops/__init__.py",
+    # the cov-map-or collective moved into ops/coverage.cov_fold_words
+    # with the mesh rebuild — the interprocedural walk must reach it or
+    # the registry row reads as stale
+    "madsim_tpu/ops/coverage.py",
     "madsim_tpu/ops/pallas_pop.py",
     "madsim_tpu/utils/__init__.py",
 )
