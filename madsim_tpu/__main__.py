@@ -371,6 +371,28 @@ def _print_attribution(stats) -> None:
     print(f"fault attribution: [{kinds or 'no failures'}]")
 
 
+def _batch_heartbeat(bi, planned, completed, el, failing, infra, abandoned,
+                     device_count=1, escalation=None, cov_txt=""):
+    """The per-batch heartbeat line (format pinned in tests): batch
+    index, throughput, the device count the unit spanned (meshed hunts
+    read differently from single-device ones in the same log), failure
+    tallies, the guided escalation rung when one exists, and the
+    coverage delta."""
+    esc_txt = f", escalation {escalation}" if escalation is not None else ""
+    return (
+        f"batch {bi}/{planned}: {completed} seeds in {el:.1f}s "
+        f"({completed / el:.0f} seeds/s) on {device_count} device(s), "
+        f"{failing} failing so far, {infra} infra, {abandoned} abandoned"
+        f"{esc_txt}{cov_txt}"
+    )
+
+
+def _device_count(args) -> int:
+    """Devices a streaming unit spans: `--devices N` meshes over N, 0
+    means the classic unsharded single-device path."""
+    return int(getattr(args, "devices", 0) or 0) or 1
+
+
 def _stream_batches(eng, args, purpose="explore"):
     """Chunked streaming driver shared by explore/hunt: run the seed
     budget as batches of `--batch` seeds (each one run_stream call), so
@@ -549,13 +571,11 @@ def _stream_batches(eng, args, purpose="explore"):
             f", coverage {slots_hit} slots (+{new_slots})"
             if cov_map is not None else ""
         )
-        log.info(
-            "batch %d/%d: %d seeds in %.1fs (%.0f seeds/s), "
-            "%d failing so far, %d infra, %d abandoned%s",
-            bi + 1, planned, out["completed"], el, out["completed"] / el,
+        log.info("%s", _batch_heartbeat(
+            bi + 1, planned, out["completed"], el,
             len(agg["failing"]), len(agg["infra"]), len(agg["abandoned"]),
-            cov_txt,
-        )
+            device_count=_device_count(args), cov_txt=cov_txt,
+        ))
         if emitter is not None:
             rec = {
                 "kind": f"{purpose}_batch",
@@ -1442,12 +1462,115 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _format_fleet_event(ev: dict, t0: float) -> str:
+    """One `fleet watch` line per event: relative seconds (wall deltas
+    between recorded timestamps — no clock is read here), the event
+    type, and the payload fields that aren't already in the prefix."""
+    ts = float(ev.get("ts") or t0)
+    skip = {"seq", "ts", "type", "job"}
+    detail = " ".join(
+        f"{k}={ev[k]}" for k in sorted(ev) if k not in skip
+        and ev[k] is not None
+    )
+    return f"+{ts - t0:9.2f}s  {ev.get('type', '?'):<16} {detail}".rstrip()
+
+
+def _fleet_watch(client, addr: str, args) -> int:
+    """`fleet watch JOB`: tail the job's SSE event stream and print one
+    line per event, exiting 0 once the stream's `end` frame reports a
+    terminal state. Push, not poll — the server parks between events."""
+    t0 = None
+    for frame in client.iter_events(addr, args.job, since=args.since):
+        data = frame.get("data")
+        if frame.get("event") == "end":
+            state = (data or {}).get("state") if isinstance(data, dict) else "?"
+            print(f"-- job {args.job} reached terminal state "
+                  f"{state!r} --")
+            return 0
+        if not isinstance(data, dict):
+            continue
+        if t0 is None:
+            t0 = float(data.get("ts") or 0.0)
+        print(_format_fleet_event(data, t0), flush=True)
+    # stream generator returned without an end frame (server gone mid-
+    # tail after retries) — surface it
+    print(f"fleet watch: stream for {args.job} closed before a "
+          f"terminal state", file=sys.stderr)
+    return 1
+
+
+def _fleet_timeline(client, addr: str, args, retries: int) -> int:
+    """`fleet timeline JOB`: fetch the merged control-plane + worker
+    Perfetto timeline and write it next to the invoker."""
+    doc = client.timeline(addr, args.job, retries=retries)
+    out_path = args.out or f"{args.job}.timeline.perfetto.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    summary = doc.get("madsim_fleet_timeline_summary", {})
+    n_ev = len(doc.get("traceEvents", []))
+    frac = float(summary.get("attribution") or 0.0)
+    print(f"timeline: {n_ev} trace events "
+          f"({summary.get('events', 0)} lifecycle events, "
+          f"{summary.get('worker_spans', 0)} worker spans), "
+          f"{frac * 100.0:.0f}% of job wall clock attributed "
+          f"-> {out_path} (open in https://ui.perfetto.dev)")
+    return 0
+
+
+def _fleet_top_render(doc: dict) -> str:
+    """One screenful of farm state from a /queue document. Pure
+    formatting — jax-free, storeless, testable."""
+    counts = doc.get("counts", {})
+    head = "fleet top — " + " ".join(
+        f"{k}:{counts[k]}" for k in sorted(counts) if counts[k]
+    ) if counts else "fleet top — queue empty"
+    cols = (f"{'JOB':<14} {'STATE':<11} {'MACHINE':<18} {'BATCH':>7} "
+            f"{'FAIL':>4} {'SLOTS':>6} {'RUNG':>4} {'MOM':>3} "
+            f"{'WORKER':<10} LAST EVENT")
+    jobs = doc.get("jobs", [])
+    lines = [head] + ([cols] if jobs else [])
+    for s in jobs:
+        mom = s.get("momentum") or {}
+        last = s.get("last_event") or {}
+        planned = s.get("batches_planned")
+        batch = (f"{s.get('batches_run', 0)}/{planned}" if planned
+                 else str(s.get("batches_run", 0)))
+        lines.append(
+            f"{s.get('id', '?'):<14} {s.get('state', '?'):<11} "
+            f"{str(s.get('machine', '?'))[:18]:<18} "
+            f"{batch:>7} "
+            f"{s.get('failing') or 0:>4} "
+            f"{s.get('coverage_slots') or 0:>6} "
+            f"{s.get('escalation') or 0:>4} "
+            f"{'*' if mom.get('active') else '.':>3} "
+            f"{str(s.get('worker') or '-')[:10]:<10} "
+            f"{last.get('type', '-')}"
+        )
+    return "\n".join(lines)
+
+
+def _fleet_top(client, addr: str, args, retries: int) -> int:
+    """`fleet top`: a one-screen live farm view rendered purely from
+    /queue (momentum and last-event are attached server-side, so this
+    verb needs no store access and stays jax-free). `--once` prints a
+    single frame for scripts/CI; otherwise redraws every --interval."""
+    import time as wall
+
+    while True:
+        print(_fleet_top_render(client.queue(addr, retries=retries)),
+              flush=True)
+        if args.once:
+            return 0
+        wall.sleep(max(0.2, args.interval))
+        print()
+
+
 def cmd_fleet(args) -> int:
     """The hunt-farm service (madsim_tpu/fleet): a durable job store +
     queue, a lease-based worker that slices jobs into checkpointed
     batch units, and a jax-free HTTP control plane + client verbs.
     Only `fleet worker` touches jax; serve/submit/status/result/cancel/
-    queue run on boxes with no accelerator stack."""
+    queue/watch/timeline/top run on boxes with no accelerator stack."""
     sub = args.fleet_cmd
     if sub == "serve":
         from .fleet import api
@@ -1549,6 +1672,12 @@ def cmd_fleet(args) -> int:
             print(json.dumps(client.queue(addr, retries=retries),
                              indent=1, sort_keys=True))
             return 0
+        if sub == "watch":
+            return _fleet_watch(client, addr, args)
+        if sub == "timeline":
+            return _fleet_timeline(client, addr, args, retries)
+        if sub == "top":
+            return _fleet_top(client, addr, args, retries)
         raise AssertionError(f"unhandled fleet verb {sub!r}")
     except (client.FleetClientError, RuntimeError, OSError) as exc:
         print(f"fleet {sub}: {exc}", file=sys.stderr)
@@ -2207,8 +2336,10 @@ def main(argv=None) -> int:
         "fingerprinted), `worker` (leases jobs, runs checkpointed "
         "batch units packed by warm-compile subkey, shrinks + files "
         "finds), `serve` (jax-free HTTP control plane: POST /jobs, "
-        "GET /jobs/{id}[/result], DELETE /jobs/{id}, /queue /metrics "
-        "/healthz) and thin client verbs",
+        "GET /jobs/{id}[/result|/events|/timeline], DELETE /jobs/{id}, "
+        "/queue /metrics /healthz) and thin client verbs, including "
+        "the observatory (`watch` SSE tail, `timeline` Perfetto "
+        "merge, `top` farm view)",
     )
     fl = p.add_subparsers(dest="fleet_cmd", required=True)
 
@@ -2345,6 +2476,12 @@ def main(argv=None) -> int:
     )
     q.add_argument("--shrink-limit", type=int, default=5,
                    help="max distinct-code finds to shrink + file")
+    q.add_argument(
+        "--devices", type=int, default=0, metavar="N",
+        help="span each batch unit over the first N devices as one "
+        "jitted SPMD program (the lane-axis mesh; 0 = unsharded). "
+        "Part of the warm-compile grouping key",
+    )
     q.add_argument("--priority", type=int, default=0,
                    help="higher runs earlier (and may pay a compile switch)")
     q.add_argument("--deadline", type=float, default=None,
@@ -2377,6 +2514,50 @@ def main(argv=None) -> int:
     q = fl.add_parser("queue", help="state counts + per-job summaries")
     obs_flags(q)
     fleet_client_flags(q)
+    q.set_defaults(fn=cmd_fleet)
+
+    q = fl.add_parser(
+        "watch",
+        help="tail a job's lifecycle event stream over SSE (push, not "
+        "poll: the server parks between events), one line per event; "
+        "exits 0 when the job reaches a terminal state",
+    )
+    obs_flags(q)
+    fleet_client_flags(q)
+    q.add_argument("job", help="job id (from `fleet submit`)")
+    q.add_argument("--since", type=int, default=0, metavar="SEQ",
+                   help="resume the tail after event SEQ (0 = replay "
+                   "the full event log first)")
+    q.set_defaults(fn=cmd_fleet)
+
+    q = fl.add_parser(
+        "timeline",
+        help="merge the job's lifecycle events with every worker's "
+        "span dump (correlated by job id as trace id) into one "
+        "Perfetto timeline: queue-wait, per-batch progress and worker "
+        "internals on a shared wall clock",
+    )
+    obs_flags(q)
+    fleet_client_flags(q)
+    q.add_argument("job", help="job id (from `fleet submit`)")
+    q.add_argument("--out", default=None, metavar="PATH",
+                   help="output trace path (default "
+                   "<job>.timeline.perfetto.json)")
+    q.set_defaults(fn=cmd_fleet)
+
+    q = fl.add_parser(
+        "top",
+        help="one-screen farm view rendered from /queue (state counts, "
+        "per-job batch/find/coverage/escalation progress, momentum, "
+        "lease holder, last event) — jax-free, needs only the HTTP "
+        "control plane",
+    )
+    obs_flags(q)
+    fleet_client_flags(q)
+    q.add_argument("--interval", type=float, default=2.0, metavar="S",
+                   help="seconds between redraws")
+    q.add_argument("--once", action="store_true",
+                   help="print a single frame and exit (scripts/CI)")
     q.set_defaults(fn=cmd_fleet)
 
     q = fl.add_parser(

@@ -58,6 +58,7 @@ JAX_FREE_ZONE = (
     "madsim_tpu.fleet.api",
     "madsim_tpu.fleet.chaos",
     "madsim_tpu.fleet.client",
+    "madsim_tpu.fleet.events",
     "madsim_tpu.fleet.fsck",
     "madsim_tpu.fleet.httpd",
     "madsim_tpu.fleet.scheduler",

@@ -14,6 +14,24 @@ read-only files, no sim/jax imports) to a read/write job API::
                              watchers stop busy-polling)
     GET    /jobs/{id}/result find + shrunk repro + `why` attribution
                              (409 until the job reaches a terminal state)
+    GET    /jobs/{id}/events the job-lifecycle event log. Push, not
+                             poll: a client sending `Accept:
+                             text/event-stream` gets Server-Sent Events
+                             tailed live from the log (?since=SEQ
+                             resumes; the stream ends with `event: end`
+                             at a terminal state, or closes at the
+                             ?wait=S / WAIT_CAP_S window for the client
+                             to reconnect). Plain GET returns the same
+                             records as a one-shot JSON document
+                             (?since=SEQ filter, ?wait=S parks until
+                             new events arrive — same deadline
+                             machinery as the /jobs/{id} long-poll).
+    GET    /jobs/{id}/timeline  the merged Perfetto timeline: control-
+                             plane lifecycle events + the worker's
+                             PerfRecorder span dumps, joined by the job
+                             id as trace id (queue-wait, compile,
+                             per-batch dispatch, shrink — one picture
+                             across both processes).
     DELETE /jobs/{id}        cancel (queued dies now; running at the next
                              unit boundary)
     GET    /metrics          Prometheus: fleet gauges (job states,
@@ -43,14 +61,16 @@ import os
 import re
 import threading
 import time
-from typing import Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from . import events as fleet_events
 from . import httpd
 from .store import CorruptJobFile, JobStore, STATES, TERMINAL
 
 _LOG = logging.getLogger("madsim_tpu.fleet.api")
 
-_JOB_RE = re.compile(r"^/jobs/([A-Za-z0-9._-]+)(/result)?$")
+_JOB_RE = re.compile(
+    r"^/jobs/([A-Za-z0-9._-]+)(/result|/events|/timeline)?$")
 
 
 def _json(status: int, doc) -> Tuple[int, str, bytes]:
@@ -60,6 +80,30 @@ def _json(status: int, doc) -> Tuple[int, str, bytes]:
 
 def _err(status: int, msg: str) -> Tuple[int, str, bytes]:
     return _json(status, {"error": msg})
+
+
+def _query_int(query: str, key: str, default: int) -> int:
+    m = re.search(rf"(?:^|&){key}=(\d+)", query)
+    return int(m.group(1)) if m else default
+
+
+def _query_wait(query: str, cap: float) -> float:
+    m = re.search(r"(?:^|&)wait=([0-9.]+)", query)
+    if not m:
+        return 0.0
+    try:
+        return min(float(m.group(1)), cap)
+    except ValueError:
+        return 0.0
+
+
+def _sse_frame(ev: dict) -> bytes:
+    """One Server-Sent-Events frame per event record: `id` carries the
+    seq (the client's reconnect cursor), `event` the type, `data` the
+    full record."""
+    data = json.dumps(ev, sort_keys=True, separators=(",", ":"))
+    return (f"id: {ev.get('seq', 0)}\nevent: {ev.get('type', 'event')}\n"
+            f"data: {data}\n\n").encode()
 
 
 def _job_summary(job) -> dict:
@@ -81,12 +125,73 @@ def _job_summary(job) -> dict:
         "coverage_slots": job.progress.get("coverage_slots"),
         "guided": bool(job.spec.get("guided", False)),
         "escalation": job.progress.get("escalation"),
+        # worker liveness for `fleet top`: who holds the lease and when
+        # it lapses (expired + non-terminal = the sweep's next customer)
+        "worker": (job.lease or {}).get("worker"),
+        "lease_expires_ts": (job.lease or {}).get("expires_ts"),
+        "attempt": job.attempt,
     }
+
+
+class _FileCache:
+    """Parsed-artifact cache keyed by (mtime_ns, size): a /metrics
+    scrape of an unchanged store does ZERO re-parses — the per-job
+    Prometheus textfiles and event logs are only re-read when their
+    stat signature moves. `parses` counts loader invocations (the unit
+    tests pin it)."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[str, tuple] = {}
+        self.parses = 0
+
+    def get(self, path: str, loader: Callable[[str], object]):
+        try:
+            st = os.stat(path)
+        except OSError:
+            self._entries.pop(path, None)
+            return None
+        key = (st.st_mtime_ns, st.st_size)
+        ent = self._entries.get(path)
+        if ent is not None and ent[0] == key:
+            return ent[1]
+        self.parses += 1
+        value = loader(path)
+        self._entries[path] = (key, value)
+        return value
+
+
+def _parse_prom(path: str) -> List[tuple]:
+    """Pre-parse a Prometheus textfile into (kind, metric_name, line)
+    rows; `# TYPE` dedup across files happens at render time."""
+    rows: List[tuple] = []
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return rows
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            rows.append(("type", line.split()[2], line))
+        elif line.startswith("#"):
+            continue
+        else:
+            rows.append(("metric", None, line))
+    return rows
 
 
 class FleetAPI:
     def __init__(self, store: JobStore):
         self.store = store
+        self._prom_cache = _FileCache()
+        self._events_cache = _FileCache()
+
+    def _job_events(self, job_id: str) -> List[dict]:
+        """The job's event log via the stat-keyed cache (scrapes and
+        queue renders re-parse only what changed)."""
+        evs = self._events_cache.get(
+            self.store.events_path(job_id),
+            lambda p: fleet_events.read_events(p))
+        return evs if isinstance(evs, list) else []
 
     # -- router --------------------------------------------------------------
 
@@ -105,16 +210,21 @@ class FleetAPI:
                 return self._submit(body)
             m = _JOB_RE.match(path)
             if m:
-                job_id, result = m.group(1), bool(m.group(2))
-                if result and method == "GET":
+                job_id, sub = m.group(1), m.group(2) or ""
+                if sub == "/result" and method == "GET":
                     return self._result(job_id)
-                if not result and method == "GET":
+                if sub == "/events" and method == "GET":
+                    return self._events(job_id, query)
+                if sub == "/timeline" and method == "GET":
+                    return self._timeline(job_id)
+                if not sub and method == "GET":
                     return self._status(job_id, query)
-                if not result and method == "DELETE":
+                if not sub and method == "DELETE":
                     return self._cancel(job_id)
             return _err(
                 404,
-                "routes: GET /queue /jobs/{id} /jobs/{id}/result /metrics "
+                "routes: GET /queue /jobs/{id} /jobs/{id}/result "
+                "/jobs/{id}/events /jobs/{id}/timeline /metrics "
                 "/healthz; POST /jobs; DELETE /jobs/{id}",
             )
         except KeyError as exc:
@@ -149,10 +259,22 @@ class FleetAPI:
                            "subkey": job.subkey})
 
     def _queue(self) -> Tuple[int, str, bytes]:
+        from .scheduler import job_momentum
+
         jobs = self.store.list()
+        summaries = []
+        for j in jobs:
+            s = _job_summary(j)
+            tail = fleet_events.tail_event(self.store.events_path(j.id))
+            if tail:
+                s["last_event"] = {k: tail.get(k)
+                                   for k in ("seq", "ts", "type", "worker")}
+            # the scheduler's live-search read, surfaced for `fleet top`
+            s["momentum"] = job_momentum(self.store, j)
+            summaries.append(s)
         return _json(200, {
             "counts": {s: n for s, n in self.store.counts().items() if n},
-            "jobs": [_job_summary(j) for j in jobs],
+            "jobs": summaries,
         })
 
     #: ?wait=S ceiling — a long-poll never parks a server thread
@@ -225,6 +347,84 @@ class FleetAPI:
             "result": job.result,
         })
 
+    # -- the event log on the wire -------------------------------------------
+
+    def _events(self, job_id: str, query: str) -> Tuple[int, str, bytes]:
+        """One-shot JSON view of the event log (`?since=SEQ` filter;
+        `?wait=S` parks until new events arrive, same deadline
+        machinery as the /jobs/{id} long-poll). The SSE view of the
+        same log is `events_stream` (negotiated by Accept header at the
+        socket layer)."""
+        job = self.store.get(job_id)  # 404/503 before touching the log
+        since = _query_int(query, "since", 0)
+        wait_s = _query_wait(query, self.WAIT_CAP_S)
+        evs = self.store.read_events(job_id, since)
+        if not evs and wait_s > 0 and not job.terminal:
+            deadline = time.monotonic() + wait_s  # madsim: allow(D001)
+            while time.monotonic() < deadline:  # madsim: allow(D001)
+                time.sleep(self.WAIT_TICK_S)  # madsim: allow(D001)
+                evs = self.store.read_events(job_id, since)
+                if evs:
+                    break
+            job = self.store.get(job_id)
+        last = max([since] + [int(e["seq"]) for e in evs])
+        return _json(200, {
+            "job": job_id,
+            "since": since,
+            "last_seq": last,
+            "state": job.state,
+            "terminal": job.terminal,
+            "events": evs,
+        })
+
+    def events_stream(self, job_id: str, since: int = 0,
+                      wait_s: Optional[float] = None) -> Iterator[bytes]:
+        """Server-Sent Events over the job's event log: replay
+        everything past `since`, then tail the log at WAIT_TICK_S
+        cadence — the `?wait=S` deadline machinery reused as the
+        tail-poll window, so no server thread parks longer than
+        WAIT_CAP_S per request (clients reconnect with
+        `since=<last id>`). A terminal state drains the log one last
+        time and closes with `event: end`."""
+        cap = self.WAIT_CAP_S if wait_s is None else min(
+            float(wait_s), self.WAIT_CAP_S)
+        deadline = time.monotonic() + max(cap, 0.0)  # madsim: allow(D001)
+        last = int(since)
+        yield b"retry: 1000\n\n"
+        while True:
+            try:
+                job = self.store.get(job_id)
+            except (KeyError, CorruptJobFile) as exc:
+                yield _sse_frame({"seq": last, "type": "error",
+                                  "error": str(exc)})
+                return
+            for ev in self.store.read_events(job_id, last):
+                last = max(last, int(ev.get("seq", last)))
+                yield _sse_frame(ev)
+            if job.terminal:
+                # one last drain: events appended between the read and
+                # the terminal-state observation must not be lost
+                for ev in self.store.read_events(job_id, last):
+                    last = max(last, int(ev.get("seq", last)))
+                    yield _sse_frame(ev)
+                yield (b"event: end\ndata: " + json.dumps(
+                    {"job": job_id, "state": job.state,
+                     "last_seq": last}).encode() + b"\n\n")
+                return
+            if time.monotonic() >= deadline:  # madsim: allow(D001)
+                return  # window over; the client reconnects with since=
+            time.sleep(self.WAIT_TICK_S)  # madsim: allow(D001)
+
+    def _timeline(self, job_id: str) -> Tuple[int, str, bytes]:
+        """The merged cross-process Perfetto timeline: lifecycle events
+        (this process's log) + the worker's span dumps, joined by the
+        job id as trace id."""
+        job = self.store.get(job_id)
+        evs = self.store.read_events(job_id)
+        spans = list(fleet_events.iter_jsonl(self.store.spans_path(job_id)))
+        return _json(200, fleet_events.timeline_doc(
+            job.to_dict(), evs, spans))
+
     def _cancel(self, job_id: str) -> Tuple[int, str, bytes]:
         job = self.store.request_cancel(job_id)
         return _json(200, {
@@ -293,28 +493,61 @@ class FleetAPI:
             f"madsim_tpu_fleet_quarantined_jobs "
             f"{counts.get('quarantined', 0)}"
         )
+        self._slo_histograms(lines, jobs)
         seen_types = {"madsim_tpu_fleet_jobs",
                       "madsim_tpu_fleet_requeues_total",
                       "madsim_tpu_fleet_lease_reclaims_total",
                       "madsim_tpu_fleet_quarantined_jobs"}
         for job in jobs:
-            prom = self.store.stats_base(job.id) + ".prom"
-            if not os.path.exists(prom):
-                continue
-            try:
-                with open(prom) as f:
-                    for line in f.read().splitlines():
-                        if line.startswith("# TYPE "):
-                            name = line.split()[2]
-                            if name in seen_types:
-                                continue
-                            seen_types.add(name)
-                        elif line.startswith("#"):
-                            continue
-                        lines.append(line)
-            except OSError:
-                continue
+            # parsed-textfile cache keyed (path, mtime, size): a scrape
+            # of an unchanged store re-parses nothing, so scrape cost
+            # stops being O(jobs) parse work
+            rows = self._prom_cache.get(
+                self.store.stats_base(job.id) + ".prom", _parse_prom)
+            for kind, name, line in rows or ():
+                if kind == "type":
+                    if name in seen_types:
+                        continue
+                    seen_types.add(name)
+                lines.append(line)
         return ("\n".join(lines) + "\n").encode()
+
+    #: SLO histogram buckets (seconds for the *_seconds metrics, plain
+    #: counts for fleet_batches_per_find — same ladder, documented)
+    SLO_BUCKETS = (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+                   300.0, 600.0)
+
+    #: metric name -> per-job SLO observation key (events.slo_observations)
+    SLO_METRICS = (
+        ("madsim_tpu_fleet_queue_wait_seconds", "queue_wait_s"),
+        ("madsim_tpu_fleet_time_to_first_find_seconds",
+         "time_to_first_find_s"),
+        ("madsim_tpu_fleet_lane_seconds_per_find", "lane_seconds_per_find"),
+        ("madsim_tpu_fleet_batches_per_find", "batches_per_find"),
+    )
+
+    def _slo_histograms(self, lines: List[str], jobs) -> None:
+        """SLO metrics derived from the event log at scrape time —
+        pure deltas over each job's events.jsonl (via the stat-keyed
+        cache), nothing precomputed or stored. A job contributes to a
+        histogram only once the underlying events exist (no finds →
+        no find-latency sample)."""
+        samples: Dict[str, List[float]] = {k: [] for _n, k in self.SLO_METRICS}
+        for job in jobs:
+            obs = fleet_events.slo_observations(self._job_events(job.id))
+            for _name, key in self.SLO_METRICS:
+                if key in obs:
+                    samples[key].append(obs[key])
+        for name, key in self.SLO_METRICS:
+            vals = samples[key]
+            lines.append(f"# TYPE {name} histogram")
+            acc = 0
+            for le in self.SLO_BUCKETS:
+                acc = sum(1 for v in vals if v <= le)
+                lines.append(f'{name}_bucket{{le="{le:g}"}} {acc}')
+            lines.append(f'{name}_bucket{{le="+Inf"}} {len(vals)}')
+            lines.append(f"{name}_sum {round(sum(vals), 6):g}")
+            lines.append(f"{name}_count {len(vals)}")
 
 
 def make_handler(api: FleetAPI):
@@ -329,7 +562,36 @@ def make_handler(api: FleetAPI):
             self.end_headers()
             self.wfile.write(payload)
 
+        def _maybe_stream_events(self) -> bool:
+            """SSE content negotiation for /jobs/{id}/events: a client
+            asking for `text/event-stream` gets the live tail — sent
+            frame by frame, flushed per event, no Content-Length (the
+            connection close delimits the stream; `fleet watch`
+            reconnects with since=<last id>)."""
+            path, _, query = self.path.partition("?")
+            m = _JOB_RE.match(path.rstrip("/") or "/")
+            if not (m and m.group(2) == "/events"
+                    and "text/event-stream" in
+                    (self.headers.get("Accept") or "")):
+                return False
+            since = _query_int(query, "since", 0)
+            wait_s = _query_wait(query, FleetAPI.WAIT_CAP_S) or None
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            try:
+                for frame in api.events_stream(m.group(1), since, wait_s):
+                    self.wfile.write(frame)
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # watcher went away; nothing to clean up
+            return True
+
         def do_GET(self):  # noqa: N802 (stdlib API name)
+            if self._maybe_stream_events():
+                return
             self._dispatch("GET")
 
         def do_POST(self):  # noqa: N802
@@ -371,7 +633,8 @@ def serve(root: str, addr: str, port_file: Optional[str] = None,
     srv, host, port = httpd.bind(addr, make_handler(FleetAPI(store)))
     print(
         f"fleet control plane on {host}:{port} (root {store.root}; "
-        f"GET /queue /jobs/{{id}} /jobs/{{id}}/result /metrics /healthz, "
+        f"GET /queue /jobs/{{id}} /jobs/{{id}}/result /jobs/{{id}}/events "
+        f"/jobs/{{id}}/timeline /metrics /healthz, "
         f"POST /jobs, DELETE /jobs/{{id}}; lease sweep every "
         f"{sweep_interval_s:g}s)",
         flush=True,

@@ -45,6 +45,17 @@ The fault vocabulary (`derive_schedule`):
 ``clean_units``   run k units with no fault (progress resets the
                   consecutive-attempt counter — quarantine only fires
                   on genuinely consecutive deaths)
+``kill_event_append``  the kill lands mid-append to a job's
+                  `.events.jsonl`: b bytes of the k-th event record
+                  reach the REAL file (appends are fsync'd but not
+                  atomic, by design), then SIGKILL — the next append's
+                  healing newline must confine the torn record to its
+                  own line, readers skip it, and the job's lifecycle
+                  (and byte-identical report) must be unaffected
+``torn_events``   external truncation of a job's `.events.jsonl` at a
+                  JSON-structural boundary — fsck must REPORT the torn
+                  tail without quarantining the log (it is an append-
+                  mode observability stream, not sim state)
 
 By default workers run the jax-free **synthetic driver** below — the
 deterministic stand-in for `_stream_batches` that drives the REAL
@@ -93,11 +104,14 @@ CHAOS_ENV = "MADSIM_TPU_FLEET_CHAOS"
 #: one torn-heavy seed)
 _PROFILES = {
     "kill": (("kill_worker", 5), ("torn_write", 1), ("corrupt_ckpt", 1),
-             ("lease_jump", 2), ("server_bounce", 1), ("clean_units", 2)),
+             ("lease_jump", 2), ("server_bounce", 1), ("clean_units", 2),
+             ("kill_event_append", 2), ("torn_events", 1)),
     "torn": (("kill_worker", 1), ("torn_write", 5), ("corrupt_ckpt", 2),
-             ("lease_jump", 1), ("server_bounce", 1), ("clean_units", 2)),
+             ("lease_jump", 1), ("server_bounce", 1), ("clean_units", 2),
+             ("kill_event_append", 1), ("torn_events", 2)),
     "mixed": (("kill_worker", 2), ("torn_write", 2), ("corrupt_ckpt", 1),
-              ("lease_jump", 2), ("server_bounce", 1), ("clean_units", 2)),
+              ("lease_jump", 2), ("server_bounce", 1), ("clean_units", 2),
+              ("kill_event_append", 1), ("torn_events", 1)),
 }
 
 
@@ -236,6 +250,14 @@ def derive_schedule(seed: int, *, profile: str = "mixed",
                 )
         elif action == "clean_units":
             ev["units"] = rng.randint(1, 3)
+        elif action == "kill_event_append":
+            # count only .events.jsonl appends; the torn prefix lands
+            # in the REAL file (appends are not atomic, by design)
+            ev["at_write"] = rng.randint(1, 6)
+            ev["at_byte"] = rng.randint(0, 80)
+        elif action == "torn_events":
+            ev["job_index"] = rng.randrange(n_jobs)
+            ev["cut"] = rng.randint(2, 25)
         events.append(ev)
     return {"seed": seed, "profile": profile, "real": real,
             "specs": specs, "events": events}
@@ -305,6 +327,36 @@ def _expire_leases(root: str) -> int:
         store._update(job.id, mut)
         n += 1
     return n
+
+
+def _tear_events_tail(path: str, cut: int) -> bool:
+    """External truncation of an append-mode event log, mid-record and
+    ON a JSON-structural character boundary inside the final record —
+    the adversarial cut positions (a prefix like `{"seq": 7, "ts":`) a
+    real torn disk write leaves behind. The invariants under test:
+    fsck REPORTS the torn tail without quarantining the log, readers
+    skip the torn line, and the next append's healing newline keeps
+    later records parseable."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return False
+    body = data.rstrip(b"\n")
+    if not body:
+        return False
+    last_nl = body.rfind(b"\n")
+    last = body[last_nl + 1:]
+    # structural positions within the last record; never 0 — an empty
+    # tail would be a clean file, not a torn one
+    marks = [i for i, c in enumerate(last) if c in b'{}[]:,"' and i > 0]
+    if not marks:
+        return False
+    target = max(1, len(last) - cut)
+    pos = min(marks, key=lambda i: abs(i - target))
+    with open(path, "r+b") as f:
+        f.truncate(last_nl + 1 + pos)
+    return True
 
 
 def _truncate_file(path: str, at_byte: int) -> bool:
@@ -445,6 +497,31 @@ def run_chaos(seed: int, *, profile: str = "mixed",
                 )
                 _note(f"round {ev['round']}: clean_units "
                       f"{ev['units']} -> rc {p.returncode}")
+            elif action == "kill_event_append":
+                # the SIGKILL lands mid-append to an events.jsonl: the
+                # match filter counts ONLY event-log appends, and the
+                # torn prefix reaches the real file before the kill
+                p = _run_worker(
+                    root,
+                    chaos={"torn_at_write": [ev["at_write"],
+                                             ev["at_byte"]],
+                           "match": ".events.jsonl"},
+                    real=real,
+                    backoff_base_s=backoff_base_s,
+                    timeout_s=worker_timeout,
+                )
+                _note(f"round {ev['round']}: kill_event_append "
+                      f"[{ev['at_write']}, {ev['at_byte']}] -> "
+                      f"rc {p.returncode}")
+            elif action == "torn_events":
+                if ev["job_index"] < len(job_ids):
+                    jid = job_ids[ev["job_index"]]
+                    hit = _tear_events_tail(
+                        JobStore(root).events_path(jid), ev["cut"]
+                    )
+                    _note(f"round {ev['round']}: torn_events {jid} "
+                          f"cut {ev['cut']} "
+                          f"({'hit' if hit else 'no events yet'})")
 
         # -- recovery: the farm must converge with no faults armed ----------
         store = JobStore(root)

@@ -18,7 +18,7 @@ import random
 import time
 import urllib.error
 import urllib.request
-from typing import Optional, Tuple
+from typing import Iterator, Optional, Tuple
 
 from . import httpd
 
@@ -161,3 +161,86 @@ def cancel(addr: str, job_id: str,
 def queue(addr: str, retries: int = DEFAULT_RETRIES) -> dict:
     _, out = request(addr, "GET", "/queue", retries=retries)
     return out
+
+
+def timeline(addr: str, job_id: str,
+             retries: int = DEFAULT_RETRIES) -> dict:
+    """The merged cross-process Perfetto timeline for a job."""
+    _, out = request(addr, "GET", f"/jobs/{job_id}/timeline",
+                     retries=retries)
+    return out
+
+
+# -- the SSE tail (push, not poll) ----------------------------------------
+
+
+def parse_sse(fp) -> Iterator[dict]:
+    """Parse a Server-Sent-Events byte stream into
+    `{"id", "event", "data"}` frames (data JSON-decoded when possible).
+    Factored off the socket so the parser unit-tests against a
+    BytesIO."""
+    frame: dict = {}
+    for raw in fp:
+        line = raw.decode("utf-8", errors="replace").rstrip("\r\n")
+        if not line:
+            if "data" in frame or "event" in frame:
+                data = frame.get("data")
+                try:
+                    frame["data"] = json.loads(data) if data else None
+                except json.JSONDecodeError:
+                    pass  # leave the raw string — the caller decides
+                yield frame
+            frame = {}
+            continue
+        if line.startswith(":"):
+            continue  # SSE comment / keepalive
+        key, _, value = line.partition(":")
+        value = value[1:] if value.startswith(" ") else value
+        if key == "data" and "data" in frame:
+            frame["data"] += "\n" + value
+        elif key in ("id", "event", "data", "retry"):
+            frame[key] = value
+    if "data" in frame or "event" in frame:
+        yield frame
+
+
+def iter_events(addr: str, job_id: str, since: int = 0,
+                timeout: float = 45.0) -> Iterator[dict]:
+    """Tail a job's event stream: yields each SSE frame, transparently
+    reconnecting with `since=<last id>` when the server's tail-poll
+    window closes the stream. Ends (without reconnecting) after an
+    `end` frame — the job reached a terminal state — or an `error`
+    frame. The per-request timeout must outlast the server's
+    WAIT_CAP_S window."""
+    cursor = int(since)
+    while True:
+        req = urllib.request.Request(
+            f"http://{addr}/jobs/{job_id}/events?since={cursor}",
+            headers={"Accept": "text/event-stream"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                for frame in parse_sse(resp):
+                    ev = frame.get("event")
+                    if frame.get("id"):
+                        try:
+                            cursor = max(cursor, int(frame["id"]))
+                        except ValueError:
+                            pass
+                    if ev == "error":
+                        data = frame.get("data")
+                        msg = (data or {}).get("error") if isinstance(
+                            data, dict) else str(data)
+                        raise FleetClientError(503, msg or "stream error")
+                    yield frame
+                    if ev == "end":
+                        return
+        except urllib.error.HTTPError as exc:
+            payload = exc.read().decode(errors="replace")
+            try:
+                msg = json.loads(payload).get("error", payload)
+            except json.JSONDecodeError:
+                msg = payload
+            raise FleetClientError(exc.code, msg) from None
+        # stream closed without `end`: the tail-poll window elapsed —
+        # reconnect from the cursor (push-not-poll with bounded parks)

@@ -171,7 +171,15 @@ def _check_ckpt(path: str, fn: str, finding: dict, jobs_by_id: dict) -> None:
         )
 
 
-def _check_jsonl(path: str, finding: dict) -> None:
+def _check_jsonl(path: str, finding: dict,
+                 torn_anywhere: bool = False) -> None:
+    """`torn_anywhere=False` (stats feeds, rewritten whole): only the
+    FINAL line may legitimately be cut — damage anywhere else is real
+    corruption. `torn_anywhere=True` (event logs / span dumps, true
+    fsync'd appends): a kill mid-append followed by the next append's
+    newline-heal leaves torn records mid-file by design, so ANY set of
+    bad lines is the reported-never-quarantined torn-tail verdict —
+    every reader skips them and the seq chain stays monotonic."""
     text, err = _read(path)
     if text is None:
         finding.update(verdict=UNPARSEABLE, detail=err)
@@ -193,6 +201,11 @@ def _check_jsonl(path: str, finding: dict) -> None:
         # quarantined
         finding.update(verdict=TORN_TAIL,
                        detail=f"final line {bad[0] + 1} cut mid-record")
+    elif torn_anywhere:
+        finding.update(verdict=TORN_TAIL,
+                       detail=f"{len(bad)} torn record(s) at lines "
+                              f"{[i + 1 for i in bad[:5]]} (append-mode "
+                              "log; readers skip them)")
     else:
         finding.update(verdict=UNPARSEABLE,
                        detail=f"unparseable lines {bad[:5]}")
@@ -248,6 +261,11 @@ def scan(store: JobStore) -> dict:
             _check_ckpt(path, fn, finding, jobs_by_id)
         elif fn.endswith(".stats.jsonl"):
             _check_jsonl(path, finding)
+        elif fn.endswith(".events.jsonl") or fn.endswith(".spans.jsonl"):
+            # append-only observability logs: torn records (even
+            # mid-file, from a kill-mid-append + newline-heal) are
+            # reported, never quarantined
+            _check_jsonl(path, finding, torn_anywhere=True)
         elif fn.endswith(".stats.json"):
             text, err = _read(path)
             if text is None:

@@ -58,6 +58,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..runtime.atomicio import atomic_write_json
 from ..runtime.checkpoint import fingerprint_from_args
+from . import events as fleet_events
 
 try:  # POSIX file locks guard read-modify-write; no-op elsewhere
     import fcntl
@@ -398,6 +399,8 @@ class JobStore:
         jobs/<id>.lock         flock guard for read-modify-write
         jobs/<id>.ckpt.json    the job's hunt checkpoint (worker-owned)
         jobs/<id>.stats.*      the job's StatsEmitter feed (jsonl/prom/json)
+        jobs/<id>.events.jsonl the job-lifecycle event log (append-only)
+        jobs/<id>.spans.jsonl  worker PerfRecorder span dumps (append-only)
         corpus.json            filed finds (corpus.CorpusEntry records)
     """
 
@@ -418,6 +421,12 @@ class JobStore:
 
     def stats_base(self, job_id: str) -> str:
         return os.path.join(self.jobs_dir, f"{job_id}.stats")
+
+    def events_path(self, job_id: str) -> str:
+        return os.path.join(self.jobs_dir, f"{job_id}.events.jsonl")
+
+    def spans_path(self, job_id: str) -> str:
+        return os.path.join(self.jobs_dir, f"{job_id}.spans.jsonl")
 
     @property
     def corpus_path(self) -> str:
@@ -474,6 +483,12 @@ class JobStore:
                 history=[[round(now, 3), QUEUED]],
             )
             self._write(job)
+            self._emit(job.id, [
+                {"type": "submitted", "machine": spec["machine"],
+                 "seeds": spec["seeds"], "batch": spec["batch"],
+                 "priority": job.priority, "subkey": job.subkey},
+                {"type": "queued"},
+            ])
         return job
 
     def get(self, job_id: str) -> Job:
@@ -512,13 +527,49 @@ class JobStore:
             c[j.state] = c.get(j.state, 0) + 1
         return c
 
+    # -- the event log (observability-class; never feeds results) ------------
+
+    def _emit(self, job_id: str, pending: List[dict]) -> None:
+        """Append pending event records to the job's lifecycle log.
+        Called under the same per-job lock as the mutation that
+        produced them, so the log is the authoritative ordered history.
+        Emission failure never breaks the store (the chaos harness
+        SIGKILLs exactly here on purpose)."""
+        if not pending or not fleet_events.enabled():
+            return
+        path = self.events_path(job_id)
+        for ev in pending:
+            ev = dict(ev)
+            type_ = ev.pop("type")
+            with contextlib.suppress(OSError):
+                fleet_events.emit_event(path, type_, job=job_id, **ev)
+
+    def emit_job_event(self, job_id: str, type_: str, *,
+                       worker: Optional[str] = None, **fields) -> None:
+        """Milestone events that do not mutate the job document (find,
+        shrink_started/shrink_done): the worker reports them through
+        the store so they take the same per-job lock — and therefore
+        the same total order — as the lifecycle events."""
+        if not fleet_events.enabled():
+            return
+        with self._locked(job_id):
+            with contextlib.suppress(OSError):
+                fleet_events.emit_event(self.events_path(job_id), type_,
+                                        job=job_id, worker=worker, **fields)
+
+    def read_events(self, job_id: str, since: int = 0) -> List[dict]:
+        return fleet_events.read_events(self.events_path(job_id), since)
+
     # -- guarded mutation ----------------------------------------------------
 
-    def _update(self, job_id: str, fn: Callable[[Job], None]) -> Job:
+    def _update(self, job_id: str, fn: Callable[[Job], None],
+                pending_events: Optional[List[dict]] = None) -> Job:
         with self._locked(job_id):
             job = self.get(job_id)
             fn(job)
             self._write(job)
+            if pending_events:
+                self._emit(job_id, pending_events)
         return job
 
     def transition(self, job_id: str, to: str, *, error: Optional[str] = None,
@@ -528,11 +579,19 @@ class JobStore:
         if to not in STATES:
             raise ValueError(f"unknown state {to!r}")
 
+        ev: List[dict] = []
+
         def mut(job: Job) -> None:
             if to not in _TRANSITIONS[job.state]:
                 raise ValueError(
                     f"illegal transition {job.state} -> {to} for {job.id}"
                 )
+            rec = {"type": to, "from": job.state}
+            if job.lease:
+                rec["worker"] = job.lease["worker"]
+            if error is not None:
+                rec["error"] = error
+            ev.append(rec)
             job.state = to
             job.history.append([round(time.time(), 3), to])
             if error is not None:
@@ -544,22 +603,27 @@ class JobStore:
             if to in TERMINAL:
                 job.lease = None
 
-        return self._update(job_id, mut)
+        return self._update(job_id, mut, ev)
 
     def request_cancel(self, job_id: str) -> Job:
         """Queued jobs cancel immediately; in-flight jobs get the flag
         and the worker finalizes at the next unit boundary."""
+
+        ev: List[dict] = []
 
         def mut(job: Job) -> None:
             if job.terminal:
                 return
             job.cancel_requested = True
             if job.state == QUEUED:
+                ev.append({"type": "cancelled", "from": job.state})
                 job.state = CANCELLED
                 job.history.append([round(time.time(), 3), CANCELLED])
                 job.lease = None
+            else:
+                ev.append({"type": "cancel_requested"})
 
-        return self._update(job_id, mut)
+        return self._update(job_id, mut, ev)
 
     # -- leases --------------------------------------------------------------
 
@@ -581,6 +645,11 @@ class JobStore:
             if (lease and lease["worker"] != worker
                     and lease["expires_ts"] > now):
                 return
+            if not (lease and lease["worker"] == worker):
+                # a NEW holder (first lease or takeover) is an event;
+                # a worker re-claiming its own lease is just a renewal
+                ev.append({"type": "leased", "worker": worker,
+                           "ttl_s": ttl_s, "attempt": job.attempt})
             job.lease = {
                 "worker": worker,
                 "expires_ts": round(now + ttl_s, 3),
@@ -588,7 +657,8 @@ class JobStore:
             }
             claimed[0] = job
 
-        self._update(job_id, mut)
+        ev: List[dict] = []
+        self._update(job_id, mut, ev)
         return claimed[0]
 
     def renew_lease(self, job_id: str, worker: str) -> None:
@@ -602,14 +672,19 @@ class JobStore:
 
     # -- deaths, requeue, quarantine -----------------------------------------
 
-    def note_progress(self, job_id: str, worker: str, progress: dict) -> Job:
+    def note_progress(self, job_id: str, worker: str, progress: dict,
+                      event_fields: Optional[dict] = None) -> Job:
         """A unit completed: merge progress, reset the consecutive-
         failure counter (deaths are only poison when consecutive) and
         renew the lease — one locked write, so the worker's per-unit
         store-write sequence stays deterministic for the chaos
-        harness's write counter."""
+        harness's write counter. `event_fields` carries the worker's
+        batch telemetry (seeds/s, elapsed, device count) into the
+        `batch_done` event."""
+        ev: List[dict] = []
 
         def mut(job: Job) -> None:
+            was_plateau = bool(job.progress.get("plateau"))
             job.progress = {**job.progress, **progress}
             job.attempt = 0
             job.requeue_after_ts = None
@@ -617,8 +692,18 @@ class JobStore:
                 job.lease["expires_ts"] = round(
                     time.time() + job.lease["ttl_s"], 3
                 )
+            rec = {"type": "batch_done", "worker": worker,
+                   "batch": job.progress.get("batches_run"),
+                   "coverage_slots": job.progress.get("coverage_slots"),
+                   "escalation": job.progress.get("escalation"),
+                   "failing": job.progress.get("failing")}
+            rec.update(event_fields or {})
+            ev.append(rec)
+            if not was_plateau and bool(job.progress.get("plateau")):
+                ev.append({"type": "plateau", "worker": worker,
+                           "batch": job.progress.get("batches_run")})
 
-        return self._update(job_id, mut)
+        return self._update(job_id, mut, ev)
 
     def record_death(self, job_id: str, *, reason: str,
                      worker: Optional[str] = None,
@@ -676,6 +761,9 @@ class JobStore:
                 job.state = QUARANTINED
                 job.history.append([round(now, 3), QUARANTINED])
                 job.requeue_after_ts = None
+                ev.append({"type": "quarantined", "worker": worker,
+                           "reason": job.quarantine["reason"],
+                           "batch": batch_index})
             else:
                 job.n_requeues += 1
                 job.requeue_after_ts = round(
@@ -684,9 +772,15 @@ class JobStore:
                 if job.state != QUEUED:
                     job.state = QUEUED
                     job.history.append([round(now, 3), QUEUED])
+                ev.append({"type": "requeued", "cause": reason,
+                           "worker": worker, "attempt": job.attempt,
+                           "backoff_s": round(
+                               backoff_base_s * (2 ** (job.attempt - 1)), 3),
+                           "batch": batch_index})
             done[0] = job
 
-        self._update(job_id, mut)
+        ev: List[dict] = []
+        self._update(job_id, mut, ev)
         return done[0]
 
     def reclaim_expired(self, *, max_attempts: int = MAX_ATTEMPTS,
@@ -733,6 +827,8 @@ class JobStore:
         post-mortem stays on the document (audit trail) until a fresh
         quarantine overwrites it."""
 
+        ev: List[dict] = []
+
         def mut(job: Job) -> None:
             if job.state != QUARANTINED:
                 raise ValueError(
@@ -743,8 +839,10 @@ class JobStore:
             job.attempt = 0
             job.requeue_after_ts = None
             job.n_requeues += 1
+            ev.append({"type": "requeued",
+                       "cause": "released from quarantine"})
 
-        return self._update(job_id, mut)
+        return self._update(job_id, mut, ev)
 
     def degrade_lanes(self, job_id: str, *, error: str,
                       worker: Optional[str] = None) -> Job:
@@ -758,6 +856,7 @@ class JobStore:
         Correctness over progress; the degradation is recorded in
         `job.degraded`."""
         new_batch: List[int] = [0]
+        ev: List[dict] = []
 
         def mut(job: Job) -> None:
             if job.terminal:
@@ -771,6 +870,8 @@ class JobStore:
                 "error": error,
                 "worker": worker,
             })
+            ev.append({"type": "degraded", "worker": worker,
+                       "from_batch": job.spec["batch"], "to_batch": nb})
             job.spec = {**job.spec, "batch": nb}
             job.fingerprint = job_fingerprint(job.spec)
             job.fingerprint_sha = spec_sha(job.spec)
@@ -781,8 +882,10 @@ class JobStore:
             if job.state != QUEUED:
                 job.state = QUEUED
                 job.history.append([round(time.time(), 3), QUEUED])
+            ev.append({"type": "requeued", "cause": "lane degradation",
+                       "worker": worker})
 
-        out = self._update(job_id, mut)
+        out = self._update(job_id, mut, ev)
         with contextlib.suppress(OSError):
             os.remove(self.ckpt_path(job_id))
         return out

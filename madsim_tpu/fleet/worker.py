@@ -191,28 +191,26 @@ class FleetWorker:
     # -- one work unit -------------------------------------------------------
 
     def _run_unit(self, job: Job) -> None:
-        from ..perf.recorder import maybe_span
+        from ..perf.recorder import PerfRecorder, current_recorder
 
         job = self.store.get(job.id)  # freshest doc (cancel flag, spec)
+        # per-unit recorder: the job id doubles as the trace id, and
+        # `wall_t0` anchors the recorder's perf_counter clock on the
+        # wall clock so the control plane can merge these spans with
+        # its lifecycle events (`fleet timeline`). An outer
+        # `--perf-timeline` recorder still sees everything: the unit's
+        # spans are absorbed back into it after the unit.
+        outer = current_recorder()
+        unit_rec = PerfRecorder(meta={
+            "trace_id": job.id, "job": job.id, "worker": self.worker_id,
+        })
+        offset_us = outer._now_us() if outer is not None else 0.0
+        wall_t0 = time.time()
         try:
-            if job.cancel_requested:
-                self._finalize_cancel(job)
-                return
-            drift = self.store.fingerprint_mismatch(job)
-            if drift:
-                self._fail(job, drift)
-                return
-            if job.deadline_ts is not None and time.time() > job.deadline_ts:
-                self._finalize(job, stop_reason="deadline")
-                return
-            ck = self._load_ckpt(job)
-            if ck is not None and ck.get("done"):
-                # a previous worker died between the last batch and
-                # finalization — nothing left to stream, just close out
-                self._finalize(job)
-                return
-            with maybe_span("fleet_unit", job=job.id, subkey=job.subkey):
-                self._stream_one_batch(job, ck)
+            with unit_rec:
+                with unit_rec.span("fleet_unit", job=job.id,
+                                   subkey=job.subkey, trace_id=job.id):
+                    self._run_unit_inner(job)
         except SystemExit as exc:
             # the streaming driver refuses drifted checkpoints (and
             # other contract violations) via sys.exit — deterministic
@@ -223,6 +221,59 @@ class FleetWorker:
             raise
         except Exception as exc:  # one broken job must not kill the farm
             self._hard_failure(job, exc)
+        finally:
+            if outer is not None:
+                outer.absorb(unit_rec, offset_us)
+            self._dump_spans(job, unit_rec, wall_t0)
+
+    def _run_unit_inner(self, job: Job) -> None:
+        if job.cancel_requested:
+            self._finalize_cancel(job)
+            return
+        drift = self.store.fingerprint_mismatch(job)
+        if drift:
+            self._fail(job, drift)
+            return
+        if job.deadline_ts is not None and time.time() > job.deadline_ts:
+            self._finalize(job, stop_reason="deadline")
+            return
+        ck = self._load_ckpt(job)
+        if ck is not None and ck.get("done"):
+            # a previous worker died between the last batch and
+            # finalization — nothing left to stream, just close out
+            self._finalize(job)
+            return
+        self._stream_one_batch(job, ck)
+
+    def _dump_spans(self, job: Job, rec, wall_t0: float) -> None:
+        """Append the unit's span dump (one JSONL record per unit) to
+        the store, for `fleet timeline`'s cross-process merge. Same
+        torn-tolerant append discipline as the event log; disabled by
+        the same switch, and never on the result path."""
+        from . import events as fleet_events
+        from ..runtime.atomicio import append_text
+
+        if not fleet_events.enabled() or not rec.spans:
+            return
+        doc = {
+            "worker": self.worker_id,
+            "job": job.id,
+            "trace_id": job.id,
+            "wall_t0": round(wall_t0, 6),
+            "spans": [
+                {"name": s["name"], "ts": round(s["ts"], 1),
+                 "dur": round(s["dur"], 1), "depth": s["depth"],
+                 "args": s["args"]}
+                for s in rec.spans if s["dur"] is not None
+            ],
+            "counters": dict(sorted(rec.counters.items())),
+        }
+        try:
+            append_text(self.store.spans_path(job.id),
+                        json.dumps(doc, sort_keys=True,
+                                   separators=(",", ":")) + "\n")
+        except OSError:
+            pass  # observability never takes a unit down
 
     def _stream_one_batch(self, job: Job, ck: Optional[dict]) -> None:
         if job.state == QUEUED:
@@ -247,13 +298,30 @@ class FleetWorker:
             engine_label = "built" if built else "cached"
         if job.state == COMPILING:
             job = self.store.transition(job.id, RUNNING)
+        prev_failing = int(job.progress.get("failing") or 0)
         ck = self._load_ckpt(job)
         progress = self._progress_from_ckpt(eng, ck)
         progress["engine"] = engine_label
+        el = time.perf_counter() - t0
+        device_count = int(job.spec.get("devices") or 0) or 1
         # one locked write: merge progress, reset the consecutive-
         # failure counter (this unit completed), renew the lease
-        job = self.store.note_progress(job.id, self.worker_id, progress)
-        el = time.perf_counter() - t0
+        job = self.store.note_progress(
+            job.id, self.worker_id, progress,
+            event_fields={
+                "elapsed_s": round(el, 3),
+                "seeds_per_sec": round(job.spec["batch"] / el, 1)
+                if el > 0 else None,
+                "device_count": device_count,
+            })
+        if progress["failing"] > prev_failing:
+            # find-at-find-time: the event lands on the stream NOW,
+            # while the job is still mid-flight — not at completion
+            self.store.emit_job_event(
+                job.id, "find", worker=self.worker_id,
+                failing=progress["failing"],
+                new_finds=progress["failing"] - prev_failing,
+                batch=progress["batches_run"])
         print(
             f"unit {job.id}: batch {progress['batches_run']}"
             f"/{progress['batches_planned']}, "
@@ -423,6 +491,9 @@ class FleetWorker:
         job = self.store.transition(job.id, FOUND, progress={
             "failing": len(failing),
         })
+        self.store.emit_job_event(
+            job.id, "shrink_started", worker=self.worker_id,
+            failing=len(failing))
         if self.driver is not None:
             # synthetic driver (chaos harness): exercise the found ->
             # shrunk -> filed lifecycle deterministically without an
@@ -437,11 +508,17 @@ class FleetWorker:
                  "note": "synthetic driver find (not filed)"}
                 for code, seeds in sorted(by_code.items())
             ]
+            self.store.emit_job_event(
+                job.id, "shrink_done", worker=self.worker_id,
+                finds=len(finds))
             job = self.store.transition(job.id, SHRUNK)
             filed = 0
         else:
             eng, _built = self._get_engine(job)
             finds = self._shrink_finds(job, eng, ck)
+            self.store.emit_job_event(
+                job.id, "shrink_done", worker=self.worker_id,
+                finds=len(finds))
             job = self.store.transition(job.id, SHRUNK)
             filed = self._file_finds(job, finds)
         self.store.transition(job.id, FILED, result={

@@ -155,6 +155,26 @@ class PerfRecorder:
     def count(self, name: str, n: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + n
 
+    def absorb(self, other: "PerfRecorder", ts_offset_us: float = 0.0) -> int:
+        """Replay another recorder's spans/counters into this one,
+        shifted by `ts_offset_us` (this recorder's clock at the moment
+        the other one started). The fleet worker uses this to nest a
+        per-unit recorder — whose spans also go to the store's span
+        dump for cross-process correlation — under an outer
+        `--perf-timeline` recorder without double-instrumenting.
+        Returns the number of spans absorbed."""
+        for s in other.spans:
+            self.spans.append({
+                "name": s["name"],
+                "ts": s["ts"] + ts_offset_us,
+                "dur": s["dur"],
+                "depth": s["depth"],
+                "args": dict(s["args"]),
+            })
+        for name, n in other.counters.items():
+            self.count(name, n)
+        return len(other.spans)
+
     # -- analysis -----------------------------------------------------------
 
     @property

@@ -82,6 +82,32 @@ def _chaos_tick(path: str, text: str) -> None:
         os.kill(os.getpid(), signal.SIGKILL)
 
 
+def _chaos_tick_append(path: str, text: str) -> None:
+    """The append-path twin of `_chaos_tick`, sharing the same write
+    counter so one armed plan schedules across both disciplines. The
+    torn variant differs on purpose: an append has no tmp file, so the
+    partial payload lands in the REAL file — exactly the torn tail the
+    jsonl readers and `fleet fsck` must tolerate."""
+    plan = _chaos_plan()
+    if not plan:
+        return
+    match = plan.get("match")
+    if match and match not in os.path.abspath(path):
+        return
+    global _WRITE_COUNT
+    _WRITE_COUNT += 1
+    n = _WRITE_COUNT
+    if plan.get("kill_at_write") == n:
+        os.kill(os.getpid(), signal.SIGKILL)
+    torn = plan.get("torn_at_write")
+    if torn and int(torn[0]) == n:
+        with open(path, "a") as f:
+            f.write(text[: int(torn[1])])
+            f.flush()
+            os.fsync(f.fileno())
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
 def fsync_dir(dirpath: str) -> None:
     """Persist a just-performed rename in `dirpath`. Best-effort: some
     filesystems refuse O_RDONLY directory fsync — that degrades back to
@@ -113,6 +139,35 @@ def atomic_write_text(path: str, text: str, *, fsync: bool = True) -> None:
     os.replace(tmp, path)
     if fsync:
         fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def append_text(path: str, text: str, *, fsync: bool = True) -> None:
+    """Append `text` to `path` (create if absent), fsync'd by default.
+
+    Appends are NOT atomic — a crash mid-append leaves a torn tail in
+    the real file, and that is a documented property, not a bug: the
+    jsonl feeds written this way (event logs, span dumps) pair with
+    readers that skip unparseable lines and an fsck verdict
+    (`torn-tail`) that reports without quarantining. To keep one torn
+    record from corrupting its successor, an append onto a file whose
+    last byte is not a newline first heals the boundary with ``"\\n"``
+    so the damage stays confined to its own line.
+    """
+    _chaos_tick_append(path, text)
+    heal = False
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            if f.tell() > 0:
+                f.seek(-1, os.SEEK_END)
+                heal = f.read(1) != b"\n"
+    except FileNotFoundError:
+        pass
+    with open(path, "a") as f:
+        f.write(("\n" if heal else "") + text)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
 
 
 def atomic_write_json(path: str, doc, *, indent: int = 1,

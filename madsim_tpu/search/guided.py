@@ -188,6 +188,23 @@ def _select_batch(
     return seeds, cursor, n_mut, op_counts
 
 
+def _guided_heartbeat(bi, planned, completed, n_mut, el, slots_hit,
+                      new_slots, failing, escalation, vocab,
+                      device_count=1, escalated_to=None):
+    """The guided per-batch heartbeat line (format pinned in tests):
+    like the unguided one it names the device count the unit spanned,
+    plus the mutation tally, coverage delta and the escalation rung the
+    batch RAN under."""
+    tail = f" -> escalated to step {escalated_to}" if escalated_to else ""
+    return (
+        f"guided batch {bi}/{planned}: {completed} seeds ({n_mut} mutants) "
+        f"in {el:.1f}s ({completed / el:.0f} seeds/s) on {device_count} "
+        f"device(s), coverage {slots_hit} slots (+{new_slots}), "
+        f"{failing} failing so far, escalation {escalation} "
+        f"[{','.join(vocab)}]{tail}"
+    )
+
+
 def run_guided(eng, args, purpose: str = "hunt") -> dict:
     """The guided batch loop. `eng` is the base (escalation step 0)
     engine — coverage gate required (the feedback signal). Returns an
@@ -464,15 +481,13 @@ def run_guided(eng, args, purpose: str = "hunt") -> dict:
             "failing": len(agg["failing"]),
             "escalated_to": escalated_to,
         })
-        log.info(
-            "guided batch %d/%d: %d seeds (%d mutants) in %.1fs "
-            "(%.0f seeds/s), coverage %d slots (+%d), %d failing so far, "
-            "escalation %d [%s]%s",
+        log.info("%s", _guided_heartbeat(
             bi + 1, planned, out["completed"], n_mut, el,
-            out["completed"] / el, slots_hit, new_slots,
-            len(agg["failing"]), ran_escalation, ",".join(vocab),
-            f" -> escalated to step {escalated_to}" if escalated_to else "",
-        )
+            slots_hit, new_slots, len(agg["failing"]),
+            ran_escalation, vocab,
+            device_count=int(getattr(args, "devices", 0) or 0) or 1,
+            escalated_to=escalated_to,
+        ))
         if emitter is not None:
             emitter.emit({
                 "kind": f"{purpose}_batch",

@@ -475,7 +475,8 @@ def test_control_plane_is_jax_free():
     code = (
         "import sys; "
         "import madsim_tpu.fleet.api, madsim_tpu.fleet.client, "
-        "madsim_tpu.fleet.store, madsim_tpu.fleet.httpd; "
+        "madsim_tpu.fleet.store, madsim_tpu.fleet.httpd, "
+        "madsim_tpu.fleet.events; "
         "from madsim_tpu.fleet.store import JobStore; "
         "import tempfile; "
         "s = JobStore(tempfile.mkdtemp()); "

@@ -501,7 +501,8 @@ def test_chaos_schedule_is_a_pure_function_of_the_seed():
     assert a != derive_schedule(8, profile="kill")
     assert derive_schedule(7, profile="torn") != a
     known = {"kill_worker", "torn_write", "corrupt_ckpt", "lease_jump",
-             "server_bounce", "clean_units"}
+             "server_bounce", "clean_units", "kill_event_append",
+             "torn_events"}
     for sched in (a, derive_schedule(3, profile="torn"),
                   derive_schedule(5, profile="mixed")):
         assert {ev["action"] for ev in sched["events"]} <= known

@@ -215,6 +215,29 @@ def test_perf_package_self_lints_clean():
         )
 
 
+def test_fleet_events_allowance_and_zone():
+    """The fleet event log's CONTRACT is wall timestamps — operators
+    correlate `fleet watch` lines with their own clocks — so
+    fleet/events.py carries the same justified file-level D001
+    allowance as perf/, must lint clean under it, and is claimed in
+    the jax-free zone (watch/timeline/top boxes never pay a jax
+    import)."""
+    path = os.path.join(REPO, "madsim_tpu", "fleet", "events.py")
+    with open(path) as f:
+        src = f.read()
+    assert "madsim: allow-file(D001)" in src
+    allow_line = [
+        l for l in src.splitlines() if "allow-file(D001)" in l
+    ][0]
+    assert "—" in allow_line or "--" in allow_line, (
+        "events.py: allow-file needs its justification on the line"
+    )
+    assert lint_main(ns(paths=[path], rules="D")) == 0
+    from madsim_tpu.analysis.layers import JAX_FREE_ZONE
+
+    assert "madsim_tpu.fleet.events" in JAX_FREE_ZONE
+
+
 # -- suppressions + baseline -------------------------------------------------
 
 
