@@ -273,17 +273,38 @@ def _main_impl() -> None:
     eng.compile_stream(batch=lanes, segment_steps=segment_steps)
     compile_s = time.perf_counter() - t0
 
-    # Pure-trace share of the compile (r12): lower the streaming
-    # supersegment once more AFTER the timed cold run — jax re-traces on
-    # every explicit .lower(), so this measures the abstract-trace cost
-    # without perturbing the cold number. trace_s is the floor a warm
-    # worker pays even when every XLA executable deserializes from the
-    # persistent cache; the AOT supersegment path (MADSIM_TPU_AOT_CACHE)
-    # is what removes it.
+    # Compile autopsy (r13, supersedes r12's trace-only re-lower): the
+    # AOT stages API re-runs trace -> lower -> backend per quartet fn
+    # AFTER the timed cold run, so the "TRACE-dominated" claim becomes
+    # three tracked numbers instead of one. trace_s keeps its r12
+    # meaning (the abstract-trace floor a warm worker pays even when
+    # every XLA executable deserializes; what MADSIM_TPU_AOT_CACHE
+    # removes), now summed over the whole quartet; lower_s and
+    # backend_s split the remainder. cost_analysis flops/bytes are
+    # normalized to ONE seed-step (the supersegment runs lanes x
+    # segment_steps x segments_per_dispatch of them) so the numbers
+    # compare across shapes; backend_s here may ride the persistent
+    # cache — the honest cold total stays compile_s.
+    segments_per_dispatch = 8  # run_stream's default dispatch grain
     with maybe_span("trace_measure"):
-        trace_s = eng.measure_stream_trace(
-            batch=lanes, segment_steps=segment_steps
+        autopsy = eng.stream_compile_autopsy(
+            batch=lanes, segment_steps=segment_steps,
+            segments_per_dispatch=segments_per_dispatch,
         )
+    trace_s = sum(r["trace_s"] for r in autopsy)
+    lower_s = sum(r["lower_s"] for r in autopsy)
+    backend_s = sum(r["backend_s"] for r in autopsy)
+    super_row = next(
+        (r for r in autopsy if r["label"] == "supersegment"), {})
+    seed_steps = lanes * segment_steps * segments_per_dispatch
+    flops_per_seed_step = (
+        round(super_row["flops"] / seed_steps, 3)
+        if super_row.get("flops") is not None else None
+    )
+    bytes_per_seed_step = (
+        round(super_row["bytes_accessed"] / seed_steps, 3)
+        if super_row.get("bytes_accessed") is not None else None
+    )
 
     def _warm_build_and_run():
         fresh = Engine(RaftMachine(num_nodes=5, log_capacity=8), cfg)
@@ -484,6 +505,10 @@ def _main_impl() -> None:
                 round(compile_s_warm, 2) if compile_s_warm is not None else None
             ),
             trace_s=round(trace_s, 2),
+            lower_s=round(lower_s, 3),
+            backend_s=round(backend_s, 3),
+            flops_per_seed_step=flops_per_seed_step,
+            bytes_per_seed_step=bytes_per_seed_step,
             spread_pct=round(100 * (max(rates) - min(rates)) / max(rates), 1),
             host_load1=load1,
             step_cost=step_cost,
@@ -519,12 +544,32 @@ def _main_impl() -> None:
                     round(compile_s_warm, 2)
                     if compile_s_warm is not None else None
                 ),
-                # the pure abstract-trace share of a compile, measured
-                # by re-lowering the supersegment post-cold: the floor
-                # a warm worker pays even when every XLA executable
-                # deserializes — unless the AOT artifact path
-                # (MADSIM_TPU_AOT_CACHE) removes the trace too
+                # the compile autopsy (r13): the cold compile split by
+                # AOT stage across the stream quartet. trace_s keeps
+                # its r12 meaning — the abstract-trace floor a warm
+                # worker pays even when every XLA executable
+                # deserializes (what MADSIM_TPU_AOT_CACHE removes) —
+                # lower_s/backend_s split the remainder; flops/bytes
+                # come from XLA cost_analysis on the supersegment,
+                # normalized to one seed-step so shapes compare
                 "trace_s": round(trace_s, 2),
+                "lower_s": round(lower_s, 3),
+                "backend_s": round(backend_s, 3),
+                "flops_per_seed_step": flops_per_seed_step,
+                "bytes_per_seed_step": bytes_per_seed_step,
+                "compile_autopsy": [
+                    {
+                        "label": r["label"],
+                        "trace_s": round(r["trace_s"], 3),
+                        "lower_s": round(r["lower_s"], 3),
+                        "backend_s": round(r["backend_s"], 3),
+                        "total_s": round(r["total_s"], 3),
+                        "flops": r["flops"],
+                        "bytes_accessed": r["bytes_accessed"],
+                        "peak_bytes": r["peak_bytes"],
+                    }
+                    for r in autopsy
+                ],
                 "steady_seeds_per_sec": round(seeds_per_sec, 1),
                 # active step-path gates: BENCH_r* files stay
                 # self-describing across this PR's flags

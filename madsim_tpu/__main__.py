@@ -1517,6 +1517,30 @@ def _fleet_timeline(client, addr: str, args, retries: int) -> int:
     return 0
 
 
+def _fleet_profile(client, addr: str, args, retries: int) -> int:
+    """`fleet profile JOB`: fetch the three-clock merge — the
+    timeline's host plane joined with the worker's device-profile
+    capture and failing-lane virtual trace (whichever the store has;
+    the worker records them when run under MADSIM_TPU_XPROF=1)."""
+    doc = client.profile(addr, args.job, retries=retries)
+    out_path = args.out or f"{args.job}.profile.perfetto.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    summary = doc.get("madsim_xprof_summary", {})
+    tracks = summary.get("tracks", {})
+    present = ", ".join(k for k in ("host", "device", "virtual")
+                        if tracks.get(k)) or "none"
+    print(f"profile: {len(doc.get('traceEvents', []))} trace events, "
+          f"tracks present: {present}, "
+          f"{summary.get('sync_points', 0)} sync points, "
+          f"{float(summary.get('attribution') or 0.0) * 100.0:.0f}% "
+          f"attributed -> {out_path} (open in https://ui.perfetto.dev)")
+    if not (tracks.get("device") or tracks.get("virtual")):
+        print("hint: run the worker with MADSIM_TPU_XPROF=1 to record "
+              "the device profile and the failing lane's virtual trace")
+    return 0
+
+
 def _fleet_top_render(doc: dict) -> str:
     """One screenful of farm state from a /queue document. Pure
     formatting — jax-free, storeless, testable."""
@@ -1570,7 +1594,8 @@ def cmd_fleet(args) -> int:
     queue, a lease-based worker that slices jobs into checkpointed
     batch units, and a jax-free HTTP control plane + client verbs.
     Only `fleet worker` touches jax; serve/submit/status/result/cancel/
-    queue/watch/timeline/top run on boxes with no accelerator stack."""
+    queue/watch/timeline/profile/top run on boxes with no accelerator
+    stack."""
     sub = args.fleet_cmd
     if sub == "serve":
         from .fleet import api
@@ -1676,6 +1701,8 @@ def cmd_fleet(args) -> int:
             return _fleet_watch(client, addr, args)
         if sub == "timeline":
             return _fleet_timeline(client, addr, args, retries)
+        if sub == "profile":
+            return _fleet_profile(client, addr, args, retries)
         if sub == "top":
             return _fleet_top(client, addr, args, retries)
         raise AssertionError(f"unhandled fleet verb {sub!r}")
@@ -1711,6 +1738,151 @@ def cmd_perf(args) -> int:
             "device memory: "
             + ", ".join(f"{k}={v}" for k, v in sorted(mem.items()))
         )
+    return 0
+
+
+def _cmd_prof_compile(args) -> int:
+    """`prof compile`: the compile autopsy — trace_s / lower_s /
+    backend_s per streaming fn at this shape, plus cost_analysis
+    flops/bytes and memory_analysis peak bytes, keyed by the same
+    `cache_subkey` bench.py warms. One JSON line + a table."""
+    import jax
+
+    from .compile_cache import cache_subkey
+
+    eng = _build_engine(args)
+    sk = _stream_kwargs(args)
+    rows = eng.stream_compile_autopsy(
+        batch=args.batch,
+        segment_steps=384,
+        max_steps=args.max_steps,
+        segments_per_dispatch=sk["segments_per_dispatch"],
+        donate=sk["donate"],
+        mesh=sk.get("mesh"),
+    )
+    subkey = cache_subkey(
+        gates={
+            "rng_stream": eng.config.rng_stream,
+            "flight_recorder": eng.config.flight_recorder,
+            "coverage": eng.config.coverage,
+            "provenance": eng.config.provenance,
+        },
+        lanes=args.batch,
+        segment_steps=384,
+        devices=sk["mesh"].size if sk.get("mesh") else 1,
+    )
+    print(json.dumps({
+        "metric": "prof_compile_autopsy",
+        "machine": args.machine,
+        "platform": jax.devices()[0].platform,
+        "cache_subkey": subkey,
+        "lanes": args.batch,
+        "fns": rows,
+    }))
+    hdr = f"{'fn':<14}{'trace_s':>9}{'lower_s':>9}{'backend_s':>11}{'flops':>14}{'bytes':>14}{'peak_bytes':>12}"
+    print(hdr)
+    for r in rows:
+        print(
+            f"{r['label']:<14}{r['trace_s']:>9.3f}{r['lower_s']:>9.3f}"
+            f"{r['backend_s']:>11.3f}"
+            f"{(r['flops'] if r['flops'] is not None else float('nan')):>14.3g}"
+            f"{(r['bytes_accessed'] if r['bytes_accessed'] is not None else float('nan')):>14.3g}"
+            f"{(r['peak_bytes'] if r['peak_bytes'] is not None else 0):>12}"
+        )
+    tot = {k: sum(r[k] for r in rows) for k in ("trace_s", "lower_s", "backend_s")}
+    bound = max(tot, key=lambda k: tot[k])
+    print(
+        f"total: trace {tot['trace_s']:.3f}s, lower {tot['lower_s']:.3f}s, "
+        f"backend {tot['backend_s']:.3f}s -> {bound.split('_')[0]}-dominated "
+        f"(subkey {subkey})"
+    )
+    return 0
+
+
+def cmd_prof(args) -> int:
+    """The three-clock profiler (madsim_tpu/perf/xprof.py): stream a
+    hunt batch with MADSIM_TPU_XPROF on — device-phase TraceAnnotations,
+    clock-sync markers at dispatch/poll boundaries, a jax.profiler
+    device capture — and, with --merge, align host wall-clock spans,
+    the device profile and the failing lane's virtual-time trace into
+    ONE Perfetto session. `prof compile` prints the per-stage compile
+    autopsy instead."""
+    import tempfile
+
+    from .perf import xprof
+    from .perf.recorder import PerfRecorder
+
+    if getattr(args, "action", None) == "compile":
+        return _cmd_prof_compile(args)
+
+    # the gate must be on before any stream fn is traced; _stream_fns
+    # keys its cache on it, so this process re-traces with the scopes in
+    os.environ[xprof.ENV_GATE] = "1"
+    eng = _build_engine(args)
+    sk = _stream_kwargs(args)
+    logdir = args.profile_dir or tempfile.mkdtemp(prefix="madsim-xprof-")
+    rec = PerfRecorder(meta={
+        "cmd": "prof", "machine": args.machine, "seeds": args.seeds,
+        "batch": args.batch,
+    })
+    # recorder INSIDE the capture: the profiler's stop/export cost (a
+    # multi-MB artifact parse+write) stays off the hunt's wall clock,
+    # so the attribution fraction measures the hunt, not the profiler
+    with xprof.device_trace(logdir):
+        with rec:
+            out = eng.run_stream(
+                args.seeds, batch=args.batch, seed_start=args.seed,
+                max_steps=args.max_steps, **sk,
+            )
+    wall_s = rec.wall_us / 1e6
+    print(
+        f"streamed {out['completed']} seeds in {wall_s:.1f}s "
+        f"({out['completed'] / max(wall_s, 1e-9):.0f} seeds/s), "
+        f"{len(out['failing'])} failing"
+    )
+    artifact = xprof.find_device_trace(logdir)
+    dev = xprof.load_device_events(artifact) if artifact else []
+    if dev:
+        print(f"device profile: {len(dev)} events ({artifact})")
+    else:
+        print("device profile: no artifact (backend without profiler export)")
+
+    if not args.merge:
+        n = rec.write(args.out)
+        print(
+            f"host timeline: {n} spans -> {args.out} "
+            f"(pass --merge for the three-clock plane)"
+        )
+        print(f"host verdict: {rec.verdict()}")
+        return 0
+
+    # virtual-time track: the failing lane when the hunt surfaced one,
+    # else the batch's first seed — timestamps stay in VIRTUAL µs
+    vseed = args.trace_seed
+    if vseed is None:
+        vseed = out["failing"][0][0] if out["failing"] else args.seed
+    from .engine import replay
+    from .engine.trace_export import trace_event_dict
+
+    rp = replay(eng, int(vseed), max_steps=args.max_steps)
+    vdoc = trace_event_dict(
+        rp.trace, machine=args.machine, seed=int(vseed),
+        num_nodes=eng.machine.NUM_NODES,
+    )
+    doc = xprof.merge_plane(
+        rec.chrome_trace(), dev, vdoc,
+        meta={"machine": args.machine, "virtual_seed": int(vseed)},
+    )
+    n = xprof.write_doc(doc, args.out)
+    s = doc["madsim_xprof_summary"]
+    print(json.dumps({"metric": "prof_merge", **s}))
+    tracks = "+".join(k for k, v in s["tracks"].items() if v)
+    print(
+        f"merged plane: {n} events ({tracks}), "
+        f"{100 * s['attribution']:.0f}% of {s['host_wall_us'] / 1e6:.1f}s "
+        f"wall attributed across {s['sync_points']} sync points "
+        f"-> {args.out} (open in https://ui.perfetto.dev)"
+    )
     return 0
 
 
@@ -2259,6 +2431,46 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_perf)
 
     p = sub.add_parser(
+        "prof",
+        help="the three-clock profiler: stream a hunt batch with "
+        "device-phase annotations + a jax.profiler capture on "
+        "(MADSIM_TPU_XPROF), and with --merge align host spans, the "
+        "device profile and a failing lane's virtual-time trace into "
+        "one Perfetto session; `prof compile` prints the per-stage "
+        "compile autopsy (trace/lower/backend + flops/bytes)",
+    )
+    common(p)
+    p.add_argument(
+        "action", nargs="?", choices=("compile",), default=None,
+        help="compile: autopsy the streaming quartet's compile at this "
+        "shape instead of running a profiled stream",
+    )
+    p.add_argument(
+        "--out", default="prof.perfetto.json",
+        help="output trace path (host timeline, or the merged "
+        "three-clock plane with --merge; .gz compresses)",
+    )
+    p.add_argument(
+        "--merge", action="store_true",
+        help="write ONE merged Perfetto session: host + device + "
+        "virtual tracks, clock-sync aligned",
+    )
+    p.add_argument("--seeds", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=512, help="lanes per streaming batch")
+    p.add_argument(
+        "--trace-seed", type=int, default=None,
+        help="seed for the virtual-time track (default: first failing "
+        "seed of the profiled batch, else --seed)",
+    )
+    p.add_argument(
+        "--profile-dir", default=None, metavar="DIR",
+        help="keep the raw jax.profiler logdir here (default: a "
+        "throwaway tempdir)",
+    )
+    stream_flags(p)
+    p.set_defaults(fn=cmd_prof)
+
+    p = sub.add_parser(
         "coverage",
         help="render a persisted scenario-coverage map (total %%, "
         "per-band marginals, thinnest fault x phase cells, per-model "
@@ -2543,6 +2755,22 @@ def main(argv=None) -> int:
     q.add_argument("--out", default=None, metavar="PATH",
                    help="output trace path (default "
                    "<job>.timeline.perfetto.json)")
+    q.set_defaults(fn=cmd_fleet)
+
+    q = fl.add_parser(
+        "profile",
+        help="the three-clock merge for a job: host timeline + the "
+        "worker's device-profile capture + the failing lane's "
+        "virtual-time trace (recorded when the worker runs under "
+        "MADSIM_TPU_XPROF=1), aligned by xprof clock-sync markers "
+        "into one Perfetto session",
+    )
+    obs_flags(q)
+    fleet_client_flags(q)
+    q.add_argument("job", help="job id (from `fleet submit`)")
+    q.add_argument("--out", default=None, metavar="PATH",
+                   help="output trace path (default "
+                   "<job>.profile.perfetto.json)")
     q.set_defaults(fn=cmd_fleet)
 
     q = fl.add_parser(

@@ -69,6 +69,7 @@ from ..ops.step_rng import (
     layout_for,
     step_words as draw_step_words,
 )
+from ..perf import xprof as _xprof
 from ..utils import set2d, tree_where
 from .machine import BOOT, Machine, Outbox
 
@@ -1897,14 +1898,15 @@ class Engine:
         idempotent); the buffer contents are left in place — only the
         live count resets, and cov_push masks dead entries to 0 anyway,
         so stale tails stay deterministic for check_determinism."""
-        cov = state.cov
-        new_map = cov_flush_batch(
-            cov["map"], cov["buf"], cov["buf_n"],
-            use_pallas=self.use_pallas_pop,
-            interpret=self._pallas_interpret,
-        )
-        zeros = jnp.zeros_like(cov["buf_n"])
-        return state.replace(cov=dict(cov, map=new_map, buf_n=zeros))
+        with _xprof.scope("cov_flush"):
+            cov = state.cov
+            new_map = cov_flush_batch(
+                cov["map"], cov["buf"], cov["buf_n"],
+                use_pallas=self.use_pallas_pop,
+                interpret=self._pallas_interpret,
+            )
+            zeros = jnp.zeros_like(cov["buf_n"])
+            return state.replace(cov=dict(cov, map=new_map, buf_n=zeros))
 
     def run_segment(self, state: LaneState, segment_steps: int) -> LaneState:
         """Advance the batch at most `segment_steps` events per lane (stops
@@ -1922,10 +1924,11 @@ class Engine:
 
         def cond(carry):
             s, it = carry
-            # madsim: collective(segment-done-any, reduce=any) — the
-            # while-cond early-exit mask: under the mesh this is the one
-            # designed per-event-step collective (a 1-bit or-all-reduce)
-            return (it < segment_steps) & jnp.any(~(s.done | s.failed))
+            with _xprof.collective_scope("segment-done-any"):
+                # madsim: collective(segment-done-any, reduce=any) — the
+                # while-cond early-exit mask: under the mesh this is the one
+                # designed per-event-step collective (a 1-bit or-all-reduce)
+                return (it < segment_steps) & jnp.any(~(s.done | s.failed))
 
         def body(carry):
             s, it = carry
@@ -1944,18 +1947,21 @@ class Engine:
                 )
             return s, it
 
-        final, _ = lax.while_loop(cond, body, (state, jnp.int32(0)))
+        with _xprof.scope("step"):
+            final, _ = lax.while_loop(cond, body, (state, jnp.int32(0)))
         if self._cov_buffered:
             # segment-exit flush — skipped only when NO lane holds a
             # buffered slot (e.g. segment_steps is a multiple of the
             # cadence, so the last body flush already drained; or every
             # lane froze before appending), which the any-reduce below
             # detects. cov-buffer-fold in srules.COLLECTIVES.
-            # madsim: collective(cov-buffer-fold, reduce=or)
-            pending = jnp.any(final.cov["buf_n"] > 0)
-            final = lax.cond(
-                pending, self._cov_flush_batch, lambda x: x, final
-            )
+            with _xprof.scope("cov_flush"):
+                with _xprof.collective_scope("cov-buffer-fold"):
+                    # madsim: collective(cov-buffer-fold, reduce=or)
+                    pending = jnp.any(final.cov["buf_n"] > 0)
+                final = lax.cond(
+                    pending, self._cov_flush_batch, lambda x: x, final
+                )
         return final
 
     def _stream_fns(
@@ -2022,9 +2028,13 @@ class Engine:
                 "gates aot to mesh=None)"
             )
         # jax.sharding.Mesh hashes by (devices, axis names), so two
-        # calls with equal meshes share one quartet
+        # calls with equal meshes share one quartet. The xprof gate is
+        # part of the key: phase scopes are inserted at TRACE time, so
+        # flipping MADSIM_TPU_XPROF between runs must re-trace rather
+        # than serve an un(der)-annotated cached quartet.
         key = (segment_steps, max_steps, ring_capacity, batch, donate,
-               segments_per_dispatch, use_scan, aot, mesh)
+               segments_per_dispatch, use_scan, aot, mesh,
+               _xprof.enabled())
         if key in cache:
             return cache[key]
 
@@ -2039,18 +2049,25 @@ class Engine:
             one-hot matrix) — so it stays cheap at pod-scale batches.
             Entries past capacity are dropped; the host's drain policy
             makes that unreachable."""
-            # madsim: collective(ring-append-ranks, reduce=scan)
-            csum = jnp.cumsum(mask.astype(jnp.int32))  # [L], rank+1 at masked lanes
-            n_new = csum[-1]
-            want_rank = jnp.arange(cap, dtype=jnp.int32) - count + 1  # 1-based
-            src = jnp.searchsorted(csum, want_rank, side="left").astype(jnp.int32)
-            fills = (want_rank >= 1) & (want_rank <= n_new)
-            # madsim: collective(ring-append-gather, reduce=gather)
-            vals = values[jnp.clip(src, 0, mask.shape[0] - 1)]
-            buf = jnp.where(fills, vals, buf)
-            return buf, count + n_new
+            with _xprof.scope("ring_append"):
+                with _xprof.collective_scope("ring-append-ranks"):
+                    # madsim: collective(ring-append-ranks, reduce=scan)
+                    csum = jnp.cumsum(mask.astype(jnp.int32))  # [L], rank+1 at masked lanes
+                n_new = csum[-1]
+                want_rank = jnp.arange(cap, dtype=jnp.int32) - count + 1  # 1-based
+                src = jnp.searchsorted(csum, want_rank, side="left").astype(jnp.int32)
+                fills = (want_rank >= 1) & (want_rank <= n_new)
+                with _xprof.collective_scope("ring-append-gather"):
+                    # madsim: collective(ring-append-gather, reduce=gather)
+                    vals = values[jnp.clip(src, 0, mask.shape[0] - 1)]
+                buf = jnp.where(fills, vals, buf)
+                return buf, count + n_new
 
         def _counters(c: StreamCarry) -> jax.Array:
+            with _xprof.scope("counters"):
+                return _counters_impl(c)
+
+        def _counters_impl(c: StreamCarry) -> jax.Array:
             over = (c.fail_count > cap) | (c.ab_count > cap)
             return jnp.stack(
                 [
@@ -2076,12 +2093,14 @@ class Engine:
             )
 
         def init_carry(seeds) -> StreamCarry:
+            with _xprof.collective_scope("seed-counter-init"):
+                # madsim: collective(seed-counter-init, reduce=gather)
+                next_seed0 = seeds[-1] + jnp.uint32(1)
             c = StreamCarry(
                 state=self.init_batch(seeds),
                 seeds=seeds,
                 done=jnp.zeros((seeds.shape[0],), bool),
-                # madsim: collective(seed-counter-init, reduce=gather)
-                next_seed=seeds[-1] + jnp.uint32(1),
+                next_seed=next_seed0,
                 completed=jnp.int32(0),
                 segments=jnp.int32(0),
                 fail_seeds=jnp.zeros((cap,), jnp.uint32),
@@ -2113,51 +2132,58 @@ class Engine:
         def _segment_impl(c: StreamCarry) -> StreamCarry:
             # 1. refill lanes harvested at the end of the previous segment
             #    (device-side ranks + seed counter: gapless, in lane order)
-            n_refill = c.done.sum(dtype=jnp.int32)  # madsim: collective(refill-count, reduce=sum)
+            with _xprof.scope("refill"):
+                with _xprof.collective_scope("refill-count"):
+                    n_refill = c.done.sum(dtype=jnp.int32)  # madsim: collective(refill-count, reduce=sum)
 
-            def do_refill(_):
-                # madsim: collective(refill-ranks, reduce=scan)
-                ranks = jnp.cumsum(c.done.astype(jnp.int32)) - 1
-                fresh_seeds = c.next_seed + ranks.astype(jnp.uint32)
-                fresh = self.init_batch(fresh_seeds)
-                return (
-                    tree_where(c.done, fresh, c.state),
-                    jnp.where(c.done, fresh_seeds, c.seeds),
-                    c.next_seed + n_refill.astype(jnp.uint32),
+                def do_refill(_):
+                    with _xprof.collective_scope("refill-ranks"):
+                        # madsim: collective(refill-ranks, reduce=scan)
+                        ranks = jnp.cumsum(c.done.astype(jnp.int32)) - 1
+                    fresh_seeds = c.next_seed + ranks.astype(jnp.uint32)
+                    fresh = self.init_batch(fresh_seeds)
+                    return (
+                        tree_where(c.done, fresh, c.state),
+                        jnp.where(c.done, fresh_seeds, c.seeds),
+                        c.next_seed + n_refill.astype(jnp.uint32),
+                    )
+
+                state, seeds, next_seed = lax.cond(
+                    n_refill > 0,
+                    do_refill,
+                    lambda _: (c.state, c.seeds, c.next_seed),
+                    None,
                 )
-
-            state, seeds, next_seed = lax.cond(
-                n_refill > 0,
-                do_refill,
-                lambda _: (c.state, c.seeds, c.next_seed),
-                None,
-            )
 
             # 2. advance the batch one segment
             state = self.run_segment(state, segment_steps)
 
             # 3. harvest on-device: count completions, ring-append failing
             #    seeds/codes and abandoned (over-cap) seeds
-            over_cap = state.step >= max_steps
-            done = state.done | state.failed | over_cap
-            completed = c.completed + done.sum(dtype=jnp.int32)  # madsim: collective(harvest-completed, reduce=sum)
-            fail_mask = done & state.failed
-            fail_seeds, fail_count = _append_ring(
-                c.fail_seeds, c.fail_count, fail_mask, seeds
-            )
-            fail_codes, _ = _append_ring(
-                c.fail_codes, c.fail_count, fail_mask, state.fail_code
-            )
-            # violation provenance words ride the same failure ring —
-            # harvested with the seeds/codes at the existing drain, zero
-            # extra steady-state syncs
-            fail_provs = c.fail_provs
-            if self.config.provenance:
-                fail_provs, _ = _append_ring(
-                    c.fail_provs, c.fail_count, fail_mask, state.fail_prov
+            with _xprof.scope("harvest"):
+                over_cap = state.step >= max_steps
+                done = state.done | state.failed | over_cap
+                with _xprof.collective_scope("harvest-completed"):
+                    completed = c.completed + done.sum(dtype=jnp.int32)  # madsim: collective(harvest-completed, reduce=sum)
+                fail_mask = done & state.failed
+                fail_seeds, fail_count = _append_ring(
+                    c.fail_seeds, c.fail_count, fail_mask, seeds
                 )
-            ab_mask = done & ~state.failed & over_cap
-            ab_seeds, ab_count = _append_ring(c.ab_seeds, c.ab_count, ab_mask, seeds)
+                fail_codes, _ = _append_ring(
+                    c.fail_codes, c.fail_count, fail_mask, state.fail_code
+                )
+                # violation provenance words ride the same failure ring —
+                # harvested with the seeds/codes at the existing drain,
+                # zero extra steady-state syncs
+                fail_provs = c.fail_provs
+                if self.config.provenance:
+                    fail_provs, _ = _append_ring(
+                        c.fail_provs, c.fail_count, fail_mask, state.fail_prov
+                    )
+                ab_mask = done & ~state.failed & over_cap
+                ab_seeds, ab_count = _append_ring(
+                    c.ab_seeds, c.ab_count, ab_mask, seeds
+                )
 
             # flight-recorder totals ride the harvest: injection counts
             # of lanes finishing THIS segment sum in, high-water marks
@@ -2166,31 +2192,36 @@ class Engine:
             # syncs)
             fr_metrics = c.fr_metrics
             if self.config.flight_recorder:
-                frs = state.fr
-                nk = len(FAULT_KIND_NAMES)
-                ne = len(FR_EXTRA_NAMES)
-                # madsim: collective(fr-fold, reduce=sum)
-                inj_tot = fr_metrics[:nk] + (
-                    frs["inj"] * done[:, None].astype(jnp.int32)
-                ).sum(axis=0)
-                extra_tot = jnp.stack(
-                    [
+                with _xprof.scope("fr_fold"):
+                    frs = state.fr
+                    nk = len(FAULT_KIND_NAMES)
+                    ne = len(FR_EXTRA_NAMES)
+                    with _xprof.collective_scope("fr-fold"):
                         # madsim: collective(fr-fold, reduce=sum)
-                        fr_metrics[nk + i] + jnp.where(done, frs[k], 0).sum()
-                        for i, k in enumerate(FR_EXTRA_NAMES)
-                    ]
-                )
-                hwm = jnp.stack(
-                    [
-                        jnp.maximum(
-                            fr_metrics[nk + ne + i],
-                            # madsim: collective(fr-hwm, reduce=max)
-                            jnp.where(done, frs[k], 0).max(),
+                        inj_tot = fr_metrics[:nk] + (
+                            frs["inj"] * done[:, None].astype(jnp.int32)
+                        ).sum(axis=0)
+                        extra_tot = jnp.stack(
+                            [
+                                # madsim: collective(fr-fold, reduce=sum)
+                                fr_metrics[nk + i] + jnp.where(done, frs[k], 0).sum()
+                                for i, k in enumerate(FR_EXTRA_NAMES)
+                            ]
                         )
-                        for i, k in enumerate(("q_hwm", "clog_hwm", "kill_hwm"))
-                    ]
-                )
-                fr_metrics = jnp.concatenate([inj_tot, extra_tot, hwm])
+                    with _xprof.collective_scope("fr-hwm"):
+                        hwm = jnp.stack(
+                            [
+                                jnp.maximum(
+                                    fr_metrics[nk + ne + i],
+                                    # madsim: collective(fr-hwm, reduce=max)
+                                    jnp.where(done, frs[k], 0).max(),
+                                )
+                                for i, k in enumerate(
+                                    ("q_hwm", "clog_hwm", "kill_hwm")
+                                )
+                            ]
+                        )
+                    fr_metrics = jnp.concatenate([inj_tot, extra_tot, hwm])
 
             # coverage rides the harvest too: OR every lane's bit map
             # into the global vector. ALL lanes, not just done ones —
@@ -2200,10 +2231,13 @@ class Engine:
             cov_map = c.cov_map
             if self.config.coverage:
                 # the cov-map-or collective lives in cov_fold_words
-                cov_map = cov_map | cov_fold_words(
-                    state.cov["map"],
-                    shards=mesh.size if mesh is not None else 1,
-                )
+                with _xprof.scope("cov_fold"), _xprof.collective_scope(
+                    "cov-map-or"
+                ):
+                    cov_map = cov_map | cov_fold_words(
+                        state.cov["map"],
+                        shards=mesh.size if mesh is not None else 1,
+                    )
 
             new = StreamCarry(
                 state=state,
@@ -2544,6 +2578,45 @@ class Engine:
         ):
             fn.lower(*avals).compile()
 
+    def stream_compile_autopsy(
+        self,
+        batch: int,
+        segment_steps: int = 256,
+        max_steps: int = 10_000,
+        segments_per_dispatch: int = 8,
+        donate: Optional[bool] = None,
+        mesh=None,
+    ) -> list:
+        """Per-fn compile autopsy of the streaming quartet at this
+        shape: trace_s / lower_s / backend_s plus cost_analysis flops /
+        bytes and memory_analysis peak bytes for each of init_carry,
+        segment, supersegment, reset_rings — the `compile_s` opaque
+        total split into the three stages the [perf] open item needs
+        apart (perf/xprof.compile_autopsy; `prof compile`, bench.py).
+        Re-traces by construction, so run it on a throwaway engine or
+        accept the duplicate trace cost."""
+        from ..perf import xprof
+
+        if donate is None:
+            donate = os.environ.get("MADSIM_TPU_STREAM_DONATE", "1") not in ("", "0")
+        init_carry, segment, supersegment, reset_rings = self._stream_fns(
+            segment_steps, max_steps, 2 * batch, batch,
+            donate=donate, segments_per_dispatch=segments_per_dispatch,
+            mesh=mesh,
+        )
+        seeds_aval = jax.ShapeDtypeStruct((batch,), jnp.uint32)
+        carry_aval = jax.eval_shape(init_carry, seeds_aval)
+        need_aval = jax.ShapeDtypeStruct((), jnp.int32)
+        return [
+            xprof.compile_autopsy(fn, avals, label=label)
+            for label, fn, avals in (
+                ("init_carry", init_carry, (seeds_aval,)),
+                ("segment", segment, (carry_aval,)),
+                ("supersegment", supersegment, (carry_aval, need_aval)),
+                ("reset_rings", reset_rings, (carry_aval,)),
+            )
+        ]
+
     def run_stream(self, n_seeds: int, **kwargs):
         """See `_run_stream_impl` (the real docstring). This wrapper
         puts the WHOLE streaming call on the host timeline as one
@@ -2730,11 +2803,19 @@ class Engine:
                     "in %.2fs): %s", what, attempt, delay_s, exc,
                 )
 
+            # Device-profile attribution (perf/xprof, MADSIM_TPU_XPROF):
+            # every executor operation lands in a jax.profiler capture
+            # as a named "madsim.<phase>" slice; the dispatch/poll loops
+            # stamp the clock-sync markers the merged plane aligns on.
+            # Gate off => the shared nullcontext: nothing inserted,
+            # bit-identity preserved by construction.
+            name = span or what
             if perf is None:
-                return retry_transient(
-                    lambda: fn(*fn_args), what=what, on_retry=on_retry
-                )
-            with perf.span(span or what):
+                with _xprof.annotation(name):
+                    return retry_transient(
+                        lambda: fn(*fn_args), what=what, on_retry=on_retry
+                    )
+            with perf.span(name), _xprof.annotation(name):
                 return retry_transient(
                     lambda: fn(*fn_args), what=what, on_retry=on_retry
                 )
@@ -2780,6 +2861,7 @@ class Engine:
 
         def poll(c: StreamCarry):
             """The blocking device->host sync: one small counters read."""
+            _xprof.sync_marker("counters_poll")
             counters = np.asarray(
                 # madsim: allow(T002) — THE designed blocking poll: one
                 # small counters read per dispatch_depth dispatches,
@@ -2814,6 +2896,7 @@ class Engine:
             while completed < n_seeds and stats["dispatches"] < max_dispatch:
                 # async dispatch: returns immediately, device work queues
                 # behind the donated carry chain
+                _xprof.sync_marker("dispatch")
                 carry = _dispatch(
                     "supersegment dispatch", supersegment, carry, need,
                     span=_span_name(supersegment, "dispatch"),
@@ -2833,6 +2916,7 @@ class Engine:
         else:
             # r5 executor: one blocking counters read per segment
             while completed < n_seeds and stats["dispatches"] < max_segments:
+                _xprof.sync_marker("dispatch")
                 carry = _dispatch(
                     "segment dispatch", segment, carry,
                     span=_span_name(segment, "dispatch"),
@@ -2854,7 +2938,9 @@ class Engine:
             # one extra small transfer, after streaming is over
             from ..runtime.metrics import fr_metrics_dict
 
-            with (perf.span("harvest") if perf else contextlib.nullcontext()):
+            with (
+                perf.span("harvest") if perf else contextlib.nullcontext()
+            ), _xprof.annotation("harvest"):
                 fr_vec = jax.device_get(carry.fr_metrics)
             fr_stats = {"flight_recorder": fr_metrics_dict(fr_vec)}
         cov_stats = {}
@@ -2865,7 +2951,9 @@ class Engine:
             # form every host-side consumer reads
             from ..runtime.coverage import coverage_dict, unpack_map
 
-            with (perf.span("harvest") if perf else contextlib.nullcontext()):
+            with (
+                perf.span("harvest") if perf else contextlib.nullcontext()
+            ), _xprof.annotation("harvest"):
                 cov_words = jax.device_get(carry.cov_map)
             cov_map_np = unpack_map(
                 np.asarray(cov_words),

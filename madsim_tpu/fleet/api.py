@@ -32,6 +32,12 @@ read-only files, no sim/jax imports) to a read/write job API::
                              id as trace id (queue-wait, compile,
                              per-batch dispatch, shrink — one picture
                              across both processes).
+    GET    /jobs/{id}/profile   the three-clock merge: the timeline's
+                             host plane + the worker's device-profile
+                             dump and failing-lane virtual trace
+                             (present when the worker ran under
+                             MADSIM_TPU_XPROF=1), aligned by
+                             `perf/xprof.py` clock-sync markers.
     DELETE /jobs/{id}        cancel (queued dies now; running at the next
                              unit boundary)
     GET    /metrics          Prometheus: fleet gauges (job states,
@@ -70,7 +76,7 @@ from .store import CorruptJobFile, JobStore, STATES, TERMINAL
 _LOG = logging.getLogger("madsim_tpu.fleet.api")
 
 _JOB_RE = re.compile(
-    r"^/jobs/([A-Za-z0-9._-]+)(/result|/events|/timeline)?$")
+    r"^/jobs/([A-Za-z0-9._-]+)(/result|/events|/timeline|/profile)?$")
 
 
 def _json(status: int, doc) -> Tuple[int, str, bytes]:
@@ -184,6 +190,7 @@ class FleetAPI:
         self.store = store
         self._prom_cache = _FileCache()
         self._events_cache = _FileCache()
+        self._bench_cache = _FileCache()
 
     def _job_events(self, job_id: str) -> List[dict]:
         """The job's event log via the stat-keyed cache (scrapes and
@@ -217,6 +224,8 @@ class FleetAPI:
                     return self._events(job_id, query)
                 if sub == "/timeline" and method == "GET":
                     return self._timeline(job_id)
+                if sub == "/profile" and method == "GET":
+                    return self._profile(job_id)
                 if not sub and method == "GET":
                     return self._status(job_id, query)
                 if not sub and method == "DELETE":
@@ -224,8 +233,8 @@ class FleetAPI:
             return _err(
                 404,
                 "routes: GET /queue /jobs/{id} /jobs/{id}/result "
-                "/jobs/{id}/events /jobs/{id}/timeline /metrics "
-                "/healthz; POST /jobs; DELETE /jobs/{id}",
+                "/jobs/{id}/events /jobs/{id}/timeline /jobs/{id}/profile "
+                "/metrics /healthz; POST /jobs; DELETE /jobs/{id}",
             )
         except KeyError as exc:
             return _err(404, str(exc.args[0]) if exc.args else "not found")
@@ -425,6 +434,36 @@ class FleetAPI:
         return _json(200, fleet_events.timeline_doc(
             job.to_dict(), evs, spans))
 
+    def _profile(self, job_id: str) -> Tuple[int, str, bytes]:
+        """The three-clock merge over the store's artifacts: the
+        /timeline doc (control-plane lifecycle + worker host spans,
+        including the worker's ``madsim.sync`` instants) is the host
+        plane; the worker's device-profile dump (written when it ran
+        under MADSIM_TPU_XPROF=1) and its failing lane's virtual-time
+        trace join it through `xprof.merge_plane` — the same alignment
+        `prof --merge` does locally, served from the store. xprof's
+        module level is stdlib-only, so this stays in the jax-free
+        control plane; with no device/virtual artifacts on disk the
+        response degrades to the host plane plus a summary saying so."""
+        from ..perf import xprof
+
+        job = self.store.get(job_id)
+        evs = self.store.read_events(job_id)
+        spans = list(fleet_events.iter_jsonl(self.store.spans_path(job_id)))
+        host = fleet_events.timeline_doc(job.to_dict(), evs, spans)
+        dev = xprof.load_device_events(self.store.device_trace_path(job_id))
+        vdoc = None
+        try:
+            with open(self.store.vtrace_path(job_id)) as f:
+                vdoc = json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError):
+            vdoc = None
+        doc = xprof.merge_plane(host, dev, vdoc, meta={
+            "job": job_id, "trace_id": job_id, "source": "fleet",
+            "state": job.state,
+        })
+        return _json(200, doc)
+
     def _cancel(self, job_id: str) -> Tuple[int, str, bytes]:
         job = self.store.request_cancel(job_id)
         return _json(200, {
@@ -494,6 +533,7 @@ class FleetAPI:
             f"{counts.get('quarantined', 0)}"
         )
         self._slo_histograms(lines, jobs)
+        self._bench_trajectory(lines)
         seen_types = {"madsim_tpu_fleet_jobs",
                       "madsim_tpu_fleet_requeues_total",
                       "madsim_tpu_fleet_lease_reclaims_total",
@@ -525,6 +565,66 @@ class FleetAPI:
         ("madsim_tpu_fleet_lane_seconds_per_find", "lane_seconds_per_find"),
         ("madsim_tpu_fleet_batches_per_find", "batches_per_find"),
     )
+
+    def _bench_trajectory(self, lines: List[str]) -> None:
+        """The BENCH_HISTORY.jsonl trajectory as gauges: for each
+        comparable-fingerprint group (platform + lanes + gate tuple +
+        host — `perf/history.comparable`), the NEWEST row's throughput
+        and warm compile, labeled by its tag. The scrape answers "what
+        is this box's current bench baseline, and which capture set
+        it" without shelling out to `bench report`; rows from other
+        boxes/configs export as their own series instead of being
+        averaged into noise. File resolution matches bench.py
+        ($MADSIM_TPU_BENCH_HISTORY, else the repo's checked-in file);
+        parsed via the stat-keyed cache — unchanged history, zero
+        re-reads. Absent file → no series (a farm box without the repo
+        checkout scrapes clean)."""
+        from ..perf import history
+
+        path = os.environ.get("MADSIM_TPU_BENCH_HISTORY") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            history.DEFAULT_BASENAME,
+        )
+        rows = self._bench_cache.get(path, history.load)
+        if not rows:
+            return
+        # newest row per comparability group, file order == time order
+        heads: List[dict] = []
+        for row in rows:
+            for i, head in enumerate(heads):
+                if history.comparable(row.get("fingerprint"),
+                                      head.get("fingerprint")):
+                    heads[i] = row
+                    break
+            else:
+                heads.append(row)
+        series = (
+            ("madsim_tpu_bench_seeds_per_sec", "value",
+             "newest capture per comparable fingerprint"),
+            ("madsim_tpu_bench_compile_s_warm", "compile_s_warm",
+             "persistent-cache warm start, same grouping"),
+        )
+        for name, key, help_text in series:
+            rendered = False
+            for row in heads:
+                val = row.get(key)
+                if val is None:
+                    continue  # e.g. no cache configured: no warm path
+                fp = row.get("fingerprint") or {}
+                labels = ",".join(
+                    f'{k}="{v}"' for k, v in (
+                        ("tag", row.get("tag", "?")),
+                        ("platform", fp.get("platform", "?")),
+                        ("lanes", fp.get("lanes", "?")),
+                        ("host", fp.get("host") or "?"),
+                    )
+                )
+                if not rendered:
+                    lines.append(f"# HELP {name} {help_text}")
+                    lines.append(f"# TYPE {name} gauge")
+                    rendered = True
+                lines.append(f"{name}{{{labels}}} {val:g}")
 
     def _slo_histograms(self, lines: List[str], jobs) -> None:
         """SLO metrics derived from the event log at scrape time —
@@ -634,7 +734,7 @@ def serve(root: str, addr: str, port_file: Optional[str] = None,
     print(
         f"fleet control plane on {host}:{port} (root {store.root}; "
         f"GET /queue /jobs/{{id}} /jobs/{{id}}/result /jobs/{{id}}/events "
-        f"/jobs/{{id}}/timeline /metrics /healthz, "
+        f"/jobs/{{id}}/timeline /jobs/{{id}}/profile /metrics /healthz, "
         f"POST /jobs, DELETE /jobs/{{id}}; lease sweep every "
         f"{sweep_interval_s:g}s)",
         flush=True,

@@ -42,6 +42,13 @@ The fault vocabulary (`derive_schedule`):
                   restart the server on the same port — a bounce-window
                   submit grows the accepted-jobs set the invariants
                   track
+``sigterm_worker``  graceful kill: SIGTERM the worker at its k-th
+                  store write (same instrumented injection point as
+                  ``kill_worker``). The invariant under test is the
+                  crash-flush path: the dying worker must leave a
+                  non-empty span dump — its open spans materialized
+                  as ``partial`` — so the killed unit's
+                  `fleet timeline` is never empty
 ``clean_units``   run k units with no fault (progress resets the
                   consecutive-attempt counter — quarantine only fires
                   on genuinely consecutive deaths)
@@ -112,6 +119,11 @@ _PROFILES = {
     "mixed": (("kill_worker", 2), ("torn_write", 2), ("corrupt_ckpt", 1),
               ("lease_jump", 2), ("server_bounce", 1), ("clean_units", 2),
               ("kill_event_append", 1), ("torn_events", 1)),
+    # satellite (PR 19): the graceful-kill profile exercises the
+    # partial-span crash flush — a NEW profile so the pinned seeds of
+    # the profiles above keep their schedules byte-identical
+    "spans": (("sigterm_worker", 5), ("kill_worker", 1),
+              ("lease_jump", 1), ("clean_units", 2)),
 }
 
 
@@ -232,6 +244,12 @@ def derive_schedule(seed: int, *, profile: str = "mixed",
         ev: dict = {"round": i, "action": action}
         if action == "kill_worker":
             ev["at_write"] = rng.randint(1, 16)
+        elif action == "sigterm_worker":
+            # counts CHECKPOINT writes only (see run_chaos): those
+            # happen strictly mid-unit, where the worker's SIGTERM
+            # flush handler is installed and spans are open — a
+            # lease-write kill would have nothing to flush by design
+            ev["at_write"] = rng.randint(1, 6)
         elif action == "torn_write":
             ev["at_write"] = rng.randint(1, 16)
             ev["at_byte"] = rng.randint(0, 200)
@@ -359,6 +377,28 @@ def _tear_events_tail(path: str, cut: int) -> bool:
     return True
 
 
+def _partial_span_dumped(root: str) -> bool:
+    """True when any job's span dump holds a span tagged ``partial`` —
+    the marker `PerfRecorder.open_spans` stamps on spans that were
+    still open when a dying worker's SIGTERM flush materialized them."""
+    store = JobStore(root)
+    for job in store.list():
+        try:
+            with open(store.spans_path(job.id)) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            for sp in rec.get("spans") or ():
+                if (sp.get("args") or {}).get("partial"):
+                    return True
+    return False
+
+
 def _truncate_file(path: str, at_byte: int) -> bool:
     """External-corruption simulation: cut a FINAL file (never what the
     farm's own fsync'd atomic writes produce). Clamped below the
@@ -425,6 +465,28 @@ def run_chaos(seed: int, *, profile: str = "mixed",
                 )
                 _note(f"round {ev['round']}: kill_worker at write "
                       f"{ev['at_write']} -> rc {p.returncode}")
+            elif action == "sigterm_worker":
+                p = _run_worker(
+                    root, chaos={"sigterm_at_write": ev["at_write"],
+                                 "match": ".ckpt"},
+                    real=real,
+                    backoff_base_s=backoff_base_s,
+                    timeout_s=worker_timeout,
+                )
+                died = p.returncode == -signal.SIGTERM
+                flushed = _partial_span_dumped(root)
+                # the satellite invariant: a gracefully killed worker
+                # leaves its open spans behind, tagged partial (if the
+                # write budget outlived the unit the worker exits
+                # clean and there is nothing to assert)
+                if died and not flushed:
+                    violations.append(
+                        f"round {ev['round']}: SIGTERM'd worker left "
+                        f"no partial span dump"
+                    )
+                _note(f"round {ev['round']}: sigterm_worker at write "
+                      f"{ev['at_write']} -> rc {p.returncode} "
+                      f"(partial spans {'flushed' if flushed else 'absent'})")
             elif action == "torn_write":
                 p = _run_worker(
                     root,

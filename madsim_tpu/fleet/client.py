@@ -171,6 +171,17 @@ def timeline(addr: str, job_id: str,
     return out
 
 
+def profile(addr: str, job_id: str,
+            retries: int = DEFAULT_RETRIES) -> dict:
+    """The three-clock merged profile for a job: the timeline's host
+    plane + the worker's device-profile capture and failing-lane
+    virtual trace (present when the worker ran under
+    MADSIM_TPU_XPROF=1), aligned by xprof clock-sync markers."""
+    _, out = request(addr, "GET", f"/jobs/{job_id}/profile",
+                     retries=retries)
+    return out
+
+
 # -- the SSE tail (push, not poll) ----------------------------------------
 
 

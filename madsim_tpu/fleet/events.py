@@ -356,11 +356,24 @@ def timeline_doc(job_doc: dict, events: List[dict],
                                 "args": {"name": "host"}})
         for sp in rec.get("spans") or []:
             try:
-                traceEvents.append({
-                    "ph": "X", "pid": pid, "tid": 0, "cat": "worker",
-                    "name": str(sp["name"]),
-                    "ts": offset + int(sp["ts"]), "dur": max(1, int(sp["dur"])),
-                    "args": dict(sp.get("args") or {}, trace_id=job_id)})
+                if sp.get("dur") is None:
+                    # recorder instants (e.g. the xprof ``madsim.sync``
+                    # clock-sync markers) ride along as ph "i" so the
+                    # /profile merge can align the device clock on them
+                    traceEvents.append({
+                        "ph": "i", "s": "t", "pid": pid, "tid": 0,
+                        "cat": "worker", "name": str(sp["name"]),
+                        "ts": offset + int(sp["ts"]),
+                        "args": dict(sp.get("args") or {},
+                                     trace_id=job_id)})
+                else:
+                    traceEvents.append({
+                        "ph": "X", "pid": pid, "tid": 0, "cat": "worker",
+                        "name": str(sp["name"]),
+                        "ts": offset + int(sp["ts"]),
+                        "dur": max(1, int(sp["dur"])),
+                        "args": dict(sp.get("args") or {},
+                                     trace_id=job_id)})
                 n_spans += 1
             except (KeyError, TypeError, ValueError):
                 continue

@@ -276,6 +276,16 @@ def scan(store: JobStore) -> dict:
                     finding.update(verdict=verdict, detail=detail)
         elif fn.endswith(".stats.prom"):
             pass  # text exposition; concatenator skips bad lines
+        elif fn.endswith(".device.trace.json.gz"):
+            pass  # binary profile capture; /profile tolerates garbage
+        elif fn.endswith(".vtrace.json"):
+            text, err = _read(path)
+            if text is None:
+                finding.update(verdict=UNPARSEABLE, detail=err)
+            else:
+                _doc, verdict, detail = _classify_json(text)
+                if verdict != OK:
+                    finding.update(verdict=verdict, detail=detail)
         elif fn.endswith(".json"):
             _check_job_doc(path, fn, finding, jobs_by_id)
         else:

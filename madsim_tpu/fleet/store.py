@@ -401,6 +401,9 @@ class JobStore:
         jobs/<id>.stats.*      the job's StatsEmitter feed (jsonl/prom/json)
         jobs/<id>.events.jsonl the job-lifecycle event log (append-only)
         jobs/<id>.spans.jsonl  worker PerfRecorder span dumps (append-only)
+        jobs/<id>.device.trace.json.gz  worker device-profile capture
+                               (MADSIM_TPU_XPROF=1 units only)
+        jobs/<id>.vtrace.json  failing lane's virtual-time trace (ditto)
         corpus.json            filed finds (corpus.CorpusEntry records)
     """
 
@@ -427,6 +430,16 @@ class JobStore:
 
     def spans_path(self, job_id: str) -> str:
         return os.path.join(self.jobs_dir, f"{job_id}.spans.jsonl")
+
+    def device_trace_path(self, job_id: str) -> str:
+        """The worker's last device-profile capture (Chrome JSON, gz) —
+        written only when the worker runs under MADSIM_TPU_XPROF=1."""
+        return os.path.join(self.jobs_dir, f"{job_id}.device.trace.json.gz")
+
+    def vtrace_path(self, job_id: str) -> str:
+        """The first failing lane's VIRTUAL-time Perfetto doc (same
+        gate as the device trace; times are simulated µs, never wall)."""
+        return os.path.join(self.jobs_dir, f"{job_id}.vtrace.json")
 
     @property
     def corpus_path(self) -> str:

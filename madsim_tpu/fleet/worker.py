@@ -191,6 +191,12 @@ class FleetWorker:
     # -- one work unit -------------------------------------------------------
 
     def _run_unit(self, job: Job) -> None:
+        import atexit
+        import contextlib
+        import signal
+        import tempfile
+
+        from ..perf import xprof
         from ..perf.recorder import PerfRecorder, current_recorder
 
         job = self.store.get(job.id)  # freshest doc (cancel flag, spec)
@@ -206,11 +212,42 @@ class FleetWorker:
         })
         offset_us = outer._now_us() if outer is not None else 0.0
         wall_t0 = time.time()
+        # crash flush: a SIGTERM'd (or atexit'd) worker dumps the
+        # spans it has SO FAR — open spans materialized as partial —
+        # before dying, so a killed unit's `fleet timeline` shows the
+        # span it died inside instead of nothing. `dumped` makes the
+        # flush once-only (the normal finally path is the same dump).
+        dumped = [False]
+
+        def _flush(signum=None, frame=None):
+            if not dumped[0]:
+                dumped[0] = True
+                with contextlib.suppress(Exception):
+                    self._dump_spans(job, unit_rec, wall_t0)
+            if signum is not None:
+                # restore the previous disposition and re-deliver so
+                # the process still dies of SIGTERM (rc 143)
+                signal.signal(signum, prev_term or signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+        prev_term = None
+        try:  # signal() only works on the main thread; tests use threads
+            prev_term = signal.signal(signal.SIGTERM, _flush)
+        except ValueError:
+            pass
+        atexit.register(_flush)
+        # device-profile capture (MADSIM_TPU_XPROF=1 units): the
+        # profiler session must OUTLIVE the recorder so its multi-second
+        # stop/export never lands on the measured host wall
+        cap_dir = tempfile.mkdtemp(prefix="madsim-fleet-xprof-") \
+            if xprof.enabled() else None
         try:
-            with unit_rec:
-                with unit_rec.span("fleet_unit", job=job.id,
-                                   subkey=job.subkey, trace_id=job.id):
-                    self._run_unit_inner(job)
+            with (xprof.device_trace(cap_dir) if cap_dir
+                  else contextlib.nullcontext()):
+                with unit_rec:
+                    with unit_rec.span("fleet_unit", job=job.id,
+                                       subkey=job.subkey, trace_id=job.id):
+                        self._run_unit_inner(job)
         except SystemExit as exc:
             # the streaming driver refuses drifted checkpoints (and
             # other contract violations) via sys.exit — deterministic
@@ -222,9 +259,16 @@ class FleetWorker:
         except Exception as exc:  # one broken job must not kill the farm
             self._hard_failure(job, exc)
         finally:
+            atexit.unregister(_flush)
+            if prev_term is not None:
+                signal.signal(signal.SIGTERM, prev_term)
             if outer is not None:
                 outer.absorb(unit_rec, offset_us)
-            self._dump_spans(job, unit_rec, wall_t0)
+            if not dumped[0]:
+                dumped[0] = True
+                self._dump_spans(job, unit_rec, wall_t0)
+            if cap_dir is not None:
+                self._save_device_trace(job, cap_dir)
 
     def _run_unit_inner(self, job: Job) -> None:
         if job.cancel_requested:
@@ -249,23 +293,29 @@ class FleetWorker:
         """Append the unit's span dump (one JSONL record per unit) to
         the store, for `fleet timeline`'s cross-process merge. Same
         torn-tolerant append discipline as the event log; disabled by
-        the same switch, and never on the result path."""
+        the same switch, and never on the result path. Instants ride
+        along with ``dur: null`` (the xprof clock-sync markers the
+        /profile merge aligns on), and on the crash-flush path the
+        recorder's still-open spans are materialized as partial."""
         from . import events as fleet_events
         from ..runtime.atomicio import append_text
 
-        if not fleet_events.enabled() or not rec.spans:
+        if not fleet_events.enabled():
+            return
+        spans_out = []
+        for s in list(rec.spans) + rec.open_spans():
+            spans_out.append(
+                {"name": s["name"], "ts": round(s["ts"], 1),
+                 "dur": None if s["dur"] is None else round(s["dur"], 1),
+                 "depth": s["depth"], "args": s["args"]})
+        if not spans_out:
             return
         doc = {
             "worker": self.worker_id,
             "job": job.id,
             "trace_id": job.id,
             "wall_t0": round(wall_t0, 6),
-            "spans": [
-                {"name": s["name"], "ts": round(s["ts"], 1),
-                 "dur": round(s["dur"], 1), "depth": s["depth"],
-                 "args": s["args"]}
-                for s in rec.spans if s["dur"] is not None
-            ],
+            "spans": spans_out,
             "counters": dict(sorted(rec.counters.items())),
         }
         try:
@@ -274,6 +324,26 @@ class FleetWorker:
                                    separators=(",", ":")) + "\n")
         except OSError:
             pass  # observability never takes a unit down
+
+    def _save_device_trace(self, job: Job, cap_dir: str) -> None:
+        """Move the unit's device-profile capture into the store
+        (last-unit-wins — the /profile merge aligns whole-unit sync
+        seqs, so mixing units would desynchronize the clocks). Never
+        on the result path; the capture dir is always cleaned up."""
+        import shutil
+
+        from ..perf import xprof
+
+        try:
+            src = xprof.find_device_trace(cap_dir)
+            if src:
+                dst = self.store.device_trace_path(job.id)
+                shutil.copyfile(src, dst + ".tmp")
+                os.replace(dst + ".tmp", dst)
+        except OSError:
+            pass  # observability never takes a unit down
+        finally:
+            shutil.rmtree(cap_dir, ignore_errors=True)
 
     def _stream_one_batch(self, job: Job, ck: Optional[dict]) -> None:
         if job.state == QUEUED:
@@ -515,6 +585,7 @@ class FleetWorker:
             filed = 0
         else:
             eng, _built = self._get_engine(job)
+            self._write_vtrace(job, eng, failing)
             finds = self._shrink_finds(job, eng, ck)
             self.store.emit_job_event(
                 job.id, "shrink_done", worker=self.worker_id,
@@ -532,6 +603,32 @@ class FleetWorker:
             f"{'y' if filed == 1 else 'ies'} from {len(failing)} failing "
             f"seeds (stop={stop_reason})", flush=True,
         )
+
+    def _write_vtrace(self, job: Job, eng, failing: List[tuple]) -> None:
+        """The third clock's fleet artifact: under MADSIM_TPU_XPROF=1 a
+        job with finds gets its first failing seed's VIRTUAL-time
+        Perfetto doc written to the store, so `/jobs/{id}/profile` can
+        merge it (unshifted — simulated µs, never wall) with the host
+        and device planes. Same observability contract as the span
+        dump: failure here never takes the job down."""
+        from ..perf import xprof
+
+        if not xprof.enabled() or not failing:
+            return
+        try:
+            from ..engine.replay import replay
+            from ..engine.trace_export import trace_event_dict
+            from ..runtime.atomicio import atomic_write_json
+
+            seed = int(failing[0][0])
+            rp = replay(eng, seed,
+                        max_steps=int(job.spec.get("max_steps") or 10_000))
+            doc = trace_event_dict(rp.trace, machine=job.spec["machine"],
+                                   seed=seed,
+                                   num_nodes=eng.machine.NUM_NODES)
+            atomic_write_json(self.store.vtrace_path(job.id), doc)
+        except Exception:
+            _LOG.exception("job %s: virtual-trace export failed", job.id)
 
     # -- shrink + why + corpus ----------------------------------------------
 

@@ -165,9 +165,12 @@ def run_batch_global(
     # replicated out_shardings.
     @partial(jax.jit, out_shardings=replicated)
     def stats(r):
+        from ..perf import xprof
+
         mask = r.failed
-        # madsim: collective(multihost-fail-ranks, reduce=scan)
-        csum = jnp.cumsum(mask.astype(jnp.int32))
+        with xprof.collective_scope("multihost-fail-ranks"):
+            # madsim: collective(multihost-fail-ranks, reduce=scan)
+            csum = jnp.cumsum(mask.astype(jnp.int32))
         n_fail = csum[-1] if mask.shape[0] else jnp.int32(0)
         want = jnp.arange(fail_capacity, dtype=jnp.int32) + 1
         src = jnp.clip(
@@ -176,14 +179,19 @@ def run_batch_global(
             max(mask.shape[0] - 1, 0),
         )
         fill = want <= n_fail
-        return {
+        with xprof.collective_scope("multihost-completed-sum"):
             # madsim: collective(multihost-completed-sum, reduce=sum)
-            "completed": r.done.sum(dtype=jnp.int32),
+            completed = r.done.sum(dtype=jnp.int32)
+        with xprof.collective_scope("multihost-fail-ring"):
+            # madsim: collective(multihost-fail-ring, reduce=gather)
+            fail_seeds = jnp.where(fill, r.seeds[src], 0)
+            # madsim: collective(multihost-fail-ring, reduce=gather)
+            fail_codes = jnp.where(fill, r.fail_code[src], 0)
+        return {
+            "completed": completed,
             "failed": n_fail,
-            # madsim: collective(multihost-fail-ring, reduce=gather)
-            "fail_seeds": jnp.where(fill, r.seeds[src], 0),
-            # madsim: collective(multihost-fail-ring, reduce=gather)
-            "fail_codes": jnp.where(fill, r.fail_code[src], 0),
+            "fail_seeds": fail_seeds,
+            "fail_codes": fail_codes,
         }
 
     out = jax.device_get(stats(res))

@@ -119,6 +119,10 @@ def make_record(
     compile_s: Optional[float] = None,
     compile_s_warm: Optional[float] = None,
     trace_s: Optional[float] = None,
+    lower_s: Optional[float] = None,
+    backend_s: Optional[float] = None,
+    flops_per_seed_step: Optional[float] = None,
+    bytes_per_seed_step: Optional[float] = None,
     spread_pct: Optional[float] = None,
     host_load1: Optional[float] = None,
     step_cost: Optional[dict] = None,
@@ -138,8 +142,18 @@ def make_record(
         "compile_s_warm": compile_s_warm,
         # trace_s = the pure abstract-trace share of a compile (what a
         # warm start pays even when every XLA executable deserializes;
-        # what the AOT supersegment path removes)
+        # what the AOT supersegment path removes). r13 splits the rest
+        # via the AOT stages API (perf/xprof.compile_autopsy): lower_s
+        # = StableHLO lowering, backend_s = XLA backend compilation —
+        # trace + lower + backend is the whole "TRACE-dominated" claim
+        # as three tracked numbers. The cost_analysis pair normalizes
+        # the compiled supersegment's work to ONE seed-step, so the
+        # numbers compare across lane counts and segment lengths.
         "trace_s": trace_s,
+        "lower_s": lower_s,
+        "backend_s": backend_s,
+        "flops_per_seed_step": flops_per_seed_step,
+        "bytes_per_seed_step": bytes_per_seed_step,
         "spread_pct": spread_pct,
         "host_load1": host_load1,
         "step_cost": step_cost,

@@ -99,6 +99,7 @@ class PerfRecorder:
         self.meta = dict(meta or {})
         self._clock = clock
         self.spans: List[dict] = []  # {"name", "ts", "dur", "depth", "args"}
+        self._open: List[dict] = []  # in-flight spans (crash-flush path)
         self.counters: Dict[str, int] = {}
         self._t0: Optional[float] = None
         self._t_end: Optional[float] = None
@@ -132,10 +133,14 @@ class PerfRecorder:
         summary can attribute wall time to OUTERMOST spans only)."""
         start = self._now_us()
         self._depth += 1
+        self._open.append(
+            {"name": name, "ts": start, "depth": self._depth - 1,
+             "args": args})
         try:
             yield self
         finally:
             self._depth -= 1
+            self._open.pop()  # spans unwind LIFO, exceptions included
             self.spans.append(
                 {
                     "name": name,
@@ -154,6 +159,24 @@ class PerfRecorder:
 
     def count(self, name: str, n: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + n
+
+    def open_spans(self) -> List[dict]:
+        """Still-open spans materialized as of NOW (dur = elapsed so
+        far, args tagged ``partial``) — the crash/SIGTERM flush path:
+        a worker killed mid-unit dumps these so its `fleet timeline`
+        shows the span it died inside instead of nothing. Does not
+        mutate recorder state; the spans keep accruing if the process
+        survives."""
+        if self._t0 is None or not self._open:
+            return []
+        now = (self._t_end - self._t0) * 1e6 if self._t_end is not None \
+            else self._now_us()
+        return [
+            {"name": s["name"], "ts": s["ts"],
+             "dur": max(now - s["ts"], 0.0), "depth": s["depth"],
+             "args": dict(s["args"], partial=True)}
+            for s in self._open
+        ]
 
     def absorb(self, other: "PerfRecorder", ts_offset_us: float = 0.0) -> int:
         """Replay another recorder's spans/counters into this one,

@@ -24,6 +24,11 @@ scheduled one dies deterministically:
 
 * ``{"kill_at_write": K}`` — SIGKILL this process *instead of* the K-th
   write. Rename atomicity means the previous file version must survive.
+* ``{"sigterm_at_write": K}`` — SIGTERM at the K-th write (once-only:
+  the plan disarms itself before delivering, so the victim's handler —
+  the worker's partial-span flush — can write through this same
+  module on its way out). The claim under test is that a gracefully
+  killed worker leaves a non-empty span dump behind.
 * ``{"torn_at_write": [K, B]}`` — the kill lands mid-write: B bytes of
   the K-th payload reach the TMP file, the rename never runs, the
   process dies. The claim "atomic" makes is exactly that the final
@@ -75,6 +80,13 @@ def _chaos_tick(path: str, text: str) -> None:
     n = _WRITE_COUNT
     if plan.get("kill_at_write") == n:
         os.kill(os.getpid(), signal.SIGKILL)
+    if plan.get("sigterm_at_write") == n:
+        # graceful-kill variant: fire ONCE and disarm before
+        # delivering, because the victim's SIGTERM handler (the
+        # worker's partial-span flush) appends through this same
+        # writer and must go through
+        plan.pop("sigterm_at_write", None)
+        os.kill(os.getpid(), signal.SIGTERM)
     torn = plan.get("torn_at_write")
     if torn and int(torn[0]) == n:
         with open(f"{path}.tmp", "w") as f:
@@ -99,6 +111,9 @@ def _chaos_tick_append(path: str, text: str) -> None:
     n = _WRITE_COUNT
     if plan.get("kill_at_write") == n:
         os.kill(os.getpid(), signal.SIGKILL)
+    if plan.get("sigterm_at_write") == n:
+        plan.pop("sigterm_at_write", None)  # once-only; see _chaos_tick
+        os.kill(os.getpid(), signal.SIGTERM)
     torn = plan.get("torn_at_write")
     if torn and int(torn[0]) == n:
         with open(path, "a") as f:

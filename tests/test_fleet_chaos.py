@@ -394,6 +394,56 @@ def test_metrics_gains_self_healing_series(tmp_path):
     assert 'madsim_tpu_fleet_jobs{state="quarantined"} 1' in text
 
 
+def test_metrics_exports_bench_history_trajectory(tmp_path, monkeypatch):
+    """/metrics exports the BENCH_HISTORY trajectory as gauges (PR 19
+    satellite): the NEWEST row per comparable-fingerprint group —
+    superseded captures drop out, different shapes stay distinct
+    series, and compile_s_warm only appears where a warm path was
+    measured. Resolution honors $MADSIM_TPU_BENCH_HISTORY; a missing
+    file exports no bench series at all."""
+    from madsim_tpu.perf import history
+
+    hp = str(tmp_path / "h.jsonl")
+    fp = {
+        "host": "boxA", "platform": "cpu", "python": "3", "jax": "0.4",
+        "jaxlib": "0.4", "lanes": 8192, "reps": 5, "segment_steps": 384,
+        "gates": {"rng_stream": 3, "clog_packed": True, "pallas_pop": False,
+                  "flight_recorder": True, "coverage": True,
+                  "provenance": False},
+    }
+    history.append(hp, history.make_record("r01", 100.0, fp, ts=1.0))
+    history.append(hp, history.make_record(
+        "r02", 110.0, fp, compile_s_warm=3.2, ts=2.0))
+    history.append(hp, history.make_record(
+        "r03", 55.0, dict(fp, lanes=512), ts=3.0))
+    monkeypatch.setenv("MADSIM_TPU_BENCH_HISTORY", hp)
+    api = FleetAPI(JobStore(str(tmp_path / "farm")))
+    _, _, body = api.handle("GET", "/metrics")
+    text = body.decode()
+    # r01 was superseded by the comparable r02; r03 is its own shape
+    assert 'madsim_tpu_bench_seeds_per_sec{tag="r02"' in text
+    assert 'lanes="8192",host="boxA"} 110' in text
+    assert 'madsim_tpu_bench_seeds_per_sec{tag="r03"' in text
+    assert 'tag="r01"' not in text
+    # warm compile: only the row that measured one exports the gauge
+    warm = [ln for ln in text.splitlines()
+            if ln.startswith("madsim_tpu_bench_compile_s_warm{")]
+    assert warm == [
+        'madsim_tpu_bench_compile_s_warm{tag="r02",platform="cpu",'
+        'lanes="8192",host="boxA"} 3.2'
+    ]
+    # scrape of an unchanged history re-parses nothing
+    parses = api._bench_cache.parses
+    api.handle("GET", "/metrics")
+    assert api._bench_cache.parses == parses
+    # missing file: no bench series, scrape still clean
+    monkeypatch.setenv("MADSIM_TPU_BENCH_HISTORY", str(tmp_path / "nope"))
+    api2 = FleetAPI(JobStore(str(tmp_path / "farm2")))
+    status, _, body = api2.handle("GET", "/metrics")
+    assert status == 200
+    assert "madsim_tpu_bench" not in body.decode()
+
+
 # -- client transient retry (satellite) --------------------------------------
 
 
@@ -512,6 +562,56 @@ def test_chaos_schedule_is_a_pure_function_of_the_seed():
     # overrides pin the shape without changing the derivation
     s = derive_schedule(7, profile="kill", rounds=3, jobs=2)
     assert len(s["events"]) == 3 and len(s["specs"]) == 2
+
+
+def test_chaos_spans_profile_schedule_derivation():
+    """The graceful-kill profile (PR 19 satellite) derives purely from
+    the seed like every other, with sigterm write budgets scoped to the
+    checkpoint-write range — and it is a NEW profile, so the pinned
+    seeds of kill/torn/mixed keep their schedules byte-identical."""
+    a = derive_schedule(0, profile="spans")
+    assert a == derive_schedule(0, profile="spans")
+    assert {ev["action"] for ev in a["events"]} <= {
+        "sigterm_worker", "kill_worker", "lease_jump", "clean_units"
+    }
+    assert any(ev["action"] == "sigterm_worker" for ev in a["events"])
+    for ev in a["events"]:
+        if ev["action"] == "sigterm_worker":
+            assert 1 <= ev["at_write"] <= 6
+    # the pre-existing profiles never emit the new action
+    for profile in ("kill", "torn", "mixed"):
+        for seed in range(4):
+            sched = derive_schedule(seed, profile=profile)
+            assert all(ev["action"] != "sigterm_worker"
+                       for ev in sched["events"])
+
+
+def test_fleet_chaos_sigterm_flushes_partial_spans(tmp_path):
+    """The crash-flush invariant under seeded attack: a worker
+    SIGTERM'd mid-unit (at its k-th checkpoint write) must leave its
+    open spans behind in the store's span dump, tagged partial — the
+    killed unit's timeline is never empty. Seed 0's schedule lands a
+    real mid-unit SIGTERM (rc -15); run_chaos itself asserts the
+    flush, and the farm is kept under --out so the dump is checked
+    directly here too. Jax-free (synthetic driver)."""
+    res = run_chaos(0, profile="spans", out_dir=str(tmp_path / "out"))
+    assert res["ok"], res["violations"]
+    out = tmp_path / "out" / "seed0"
+    assert json.load(open(out / "schedule.json")) == derive_schedule(
+        0, profile="spans")
+    st = JobStore(str(out / "farm"))
+    partials = [
+        dict(sp, job=job.id)
+        for job in st.list()
+        for line in open(st.spans_path(job.id))
+        for sp in json.loads(line).get("spans") or ()
+        if (sp.get("args") or {}).get("partial")
+    ]
+    assert partials, "no partial span survived the SIGTERM rounds"
+    # the flush dumped the open stack: the unit span itself is there,
+    # with a real duration (ran to the moment of death, not zero)
+    assert any(sp["name"] == "fleet_unit" for sp in partials)
+    assert all(sp["dur"] > 0 for sp in partials)
 
 
 def test_fleet_chaos_end_to_end_pinned_seed(tmp_path):

@@ -106,6 +106,31 @@ def test_recorder_contextvar_scoping():
     assert [s["name"] for s in rec.spans] == ["spanned"]
 
 
+def test_recorder_open_spans_crash_flush_view():
+    """open_spans materializes the still-open stack mid-run — the
+    crash-flush path (fleet worker SIGTERM/atexit) dumps these so a
+    killed unit's timeline is never empty. Durations run to `now`,
+    depths are the live nesting, and every span is tagged partial."""
+    clk = FakeClock()
+    rec = PerfRecorder(clock=clk)
+    assert rec.open_spans() == []  # before entry: nothing to flush
+    with rec:
+        with rec.span("unit", batch=32):
+            clk.tick(1.0)
+            with rec.span("dispatch"):
+                clk.tick(0.25)
+                got = rec.open_spans()
+    assert [s["name"] for s in got] == ["unit", "dispatch"]
+    assert [s["depth"] for s in got] == [0, 1]
+    assert got[0]["dur"] == pytest.approx(1.25e6)  # µs, runs to now
+    assert got[1]["dur"] == pytest.approx(0.25e6)
+    assert got[0]["args"] == {"batch": 32, "partial": True}
+    assert got[1]["args"] == {"partial": True}
+    # after clean exit the stack is empty — nothing double-reports
+    assert rec.open_spans() == []
+    assert [s["name"] for s in rec.spans] == ["dispatch", "unit"]
+
+
 def test_recorder_not_reenterable():
     rec = PerfRecorder(clock=FakeClock())
     with rec:
@@ -524,23 +549,55 @@ def test_bench_reports_cold_and_warm_compile_keys():
 def test_bench_reports_trace_s_and_cold_trace_mode():
     """bench.py's r12 contract additions: trace_s emitted as its own
     key (the pure abstract-trace share a warm worker pays even when
-    every XLA executable deserializes), measured via the engine's
-    post-cold re-lower, and the MADSIM_TPU_BENCH_COLD_TRACE env wires
-    through to measure_warm_compile's AOT-suspended mode (source pin —
-    the flagship bench is out of tier-1 budget; CI's bench step
-    asserts the live values)."""
+    every XLA executable deserializes) — since r13 measured by the
+    compile autopsy's per-stage split rather than the old re-lower —
+    and the MADSIM_TPU_BENCH_COLD_TRACE env wires through to
+    measure_warm_compile's AOT-suspended mode (source pin — the
+    flagship bench is out of tier-1 budget; CI's bench step asserts
+    the live values)."""
     import inspect
 
     from madsim_tpu import compile_cache as cc
 
     src = open(os.path.join(REPO, "bench.py")).read()
     assert '"trace_s"' in src
-    assert "measure_stream_trace" in src
     assert "MADSIM_TPU_BENCH_COLD_TRACE" in src
     assert "cold_trace=cold_trace" in src
     assert "cold_trace" in inspect.signature(cc.measure_warm_compile).parameters
     # the coverage-unbuffered escape hatch stays A/B-able from the bench
     assert "coverage_unbuffered" in src and "cov_buffer=0" in src
+
+
+def test_bench_reports_compile_autopsy_split(tmp_path):
+    """bench.py's r13 contract: the compile is split by AOT stage
+    (trace_s / lower_s / backend_s summed over the stream quartet) via
+    the engine's stream_compile_autopsy, with XLA cost_analysis
+    flops/bytes normalized per seed-step, and the same four fields ride
+    the BENCH_HISTORY record — with GATE_KEYS untouched so r13 rows
+    stay comparable to r12 (source pin for the bench itself; the live
+    values are asserted by the CI bench step and BENCH_r13.json)."""
+    src = open(os.path.join(REPO, "bench.py")).read()
+    for key in ('"lower_s"', '"backend_s"', '"flops_per_seed_step"',
+                '"bytes_per_seed_step"', '"compile_autopsy"'):
+        assert key in src, key
+    assert "stream_compile_autopsy" in src
+    # comparability contract: the autopsy must not widen the gate tuple
+    assert history.GATE_KEYS == (
+        "rng_stream", "clog_packed", "pallas_pop", "flight_recorder",
+        "coverage", "provenance",
+    )
+    # and the history record round-trips the split
+    rec = history.make_record(
+        "r98", 100.0, _fp(), compile_s=22.1, trace_s=14.0, lower_s=3.2,
+        backend_s=4.9, flops_per_seed_step=7.5, bytes_per_seed_step=34.0,
+    )
+    p = str(tmp_path / "h.jsonl")
+    history.append(p, rec)
+    [row] = history.load(p)
+    assert row["trace_s"] == 14.0 and row["lower_s"] == 3.2
+    assert row["backend_s"] == 4.9
+    assert row["flops_per_seed_step"] == 7.5
+    assert row["bytes_per_seed_step"] == 34.0
 
 
 def test_aot_warm_start_beats_cold_trace(tmp_path, monkeypatch):
