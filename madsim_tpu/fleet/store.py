@@ -375,6 +375,13 @@ class Job:
     quarantine: Optional[dict] = None
     n_requeues: int = 0
     n_lease_reclaims: int = 0
+    #: monotonic fencing token: bumped every time the lease passes to
+    #: a NEW hold (first lease, takeover, or re-lease after a
+    #: reclaim). The live lease dict carries the current value as
+    #: ``lease["gen"]``; a worker's renewal/progress writes CAS
+    #: against it, so a reclaimed ("zombie") hold can never resurrect
+    #: its lease or merge state the next holder doesn't expect.
+    lease_gen: int = 0
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -659,14 +666,19 @@ class JobStore:
                     and lease["expires_ts"] > now):
                 return
             if not (lease and lease["worker"] == worker):
-                # a NEW holder (first lease or takeover) is an event;
-                # a worker re-claiming its own lease is just a renewal
+                # a NEW holder (first lease, takeover, or re-lease
+                # after a reclaim cleared it) starts a new lease
+                # generation and is an event; a worker re-claiming its
+                # own lease is just a renewal and keeps the generation
+                job.lease_gen += 1
                 ev.append({"type": "leased", "worker": worker,
-                           "ttl_s": ttl_s, "attempt": job.attempt})
+                           "ttl_s": ttl_s, "attempt": job.attempt,
+                           "gen": job.lease_gen})
             job.lease = {
                 "worker": worker,
                 "expires_ts": round(now + ttl_s, 3),
                 "ttl_s": ttl_s,
+                "gen": job.lease_gen,
             }
             claimed[0] = job
 
@@ -674,14 +686,30 @@ class JobStore:
         self._update(job_id, mut, ev)
         return claimed[0]
 
-    def renew_lease(self, job_id: str, worker: str) -> None:
+    def renew_lease(self, job_id: str, worker: str,
+                    gen: Optional[int] = None) -> bool:
+        """Heartbeat renewal as a compare-and-swap on the lease
+        generation. `reclaim_expired` can fire between a live worker's
+        last read and its renewal: worker-identity alone would then
+        either no-op silently (lease cleared) or — worse, when the
+        same worker re-leased in between — resurrect a hold from a
+        dead generation. The CAS renews only while `worker` still
+        holds generation `gen` and reports the outcome, so the caller
+        learns it lost the job instead of streaming on. `gen=None`
+        checks worker identity only (pre-fencing callers)."""
+        renewed = [False]
+
         def mut(job: Job) -> None:
-            if job.lease and job.lease["worker"] == worker:
-                job.lease["expires_ts"] = round(
-                    time.time() + job.lease["ttl_s"], 3
-                )
+            lease = job.lease
+            if not (lease and lease["worker"] == worker):
+                return
+            if gen is not None and lease.get("gen", 0) != gen:
+                return
+            lease["expires_ts"] = round(time.time() + lease["ttl_s"], 3)
+            renewed[0] = True
 
         self._update(job_id, mut)
+        return renewed[0]
 
     # -- deaths, requeue, quarantine -----------------------------------------
 

@@ -1,0 +1,111 @@
+"""Many workers, one queue (madsim_tpu/fleet under contention): lease
+generations + CAS renewal, fencing tokens, the O_EXCL claim protocol,
+the log-structured queue index, admission control, and the multi-worker
+chaos invariants.
+
+Everything here is jax-compile-free — the control plane is jax-free by
+contract (pinned in test_fleet.py) and the few subprocess tests run
+synthetic drivers only.
+"""
+
+import json
+import os
+
+import pytest
+
+from madsim_tpu.fleet.store import (
+    QUEUED,
+    QUARANTINED,
+    JobStore,
+)
+
+ECHO_SPEC = {"machine": "echo", "seeds": 96, "batch": 32, "faults": 0,
+             "horizon": 1.0, "max_steps": 300}
+
+
+def _expire(st, job_id):
+    """Hand the current holder an already-expired lease (the chaos
+    harness's lease-jump, at store scale)."""
+
+    def mut(job):
+        if job.lease:
+            job.lease["expires_ts"] = 0.0
+
+    st._update(job_id, mut)
+
+
+# -- lease generations + CAS renewal (the 1-worker fencing corner) -----------
+
+
+def test_renew_lease_cas_rejects_reclaimed_generation(tmp_path):
+    """Regression for the lease-reclaim/heartbeat race: the reclaim
+    sweep fires between a live worker's last read and its renewal
+    write. Worker-identity renewal either no-ops silently (worker keeps
+    streaming on a job it lost) or — when the same worker re-leased in
+    between — resurrects a hold from a dead generation. The CAS refuses
+    both and says so."""
+    st = JobStore(str(tmp_path))
+    job = st.submit(dict(ECHO_SPEC))
+
+    held = st.try_lease(job.id, "w1", ttl_s=60)
+    gen1 = held.lease["gen"]
+    assert gen1 == 1 and held.lease_gen == 1
+    # renewing the live generation succeeds (and reports it)
+    assert st.renew_lease(job.id, "w1", gen=gen1) is True
+    # a worker re-claiming its OWN live lease keeps the generation
+    assert st.try_lease(job.id, "w1", ttl_s=60).lease["gen"] == gen1
+
+    # the lease expires and the sweep reclaims it mid-heartbeat
+    _expire(st, job.id)
+    acts = st.reclaim_expired(backoff_base_s=0.0)
+    assert [a["outcome"] for a in acts] == [QUEUED]
+    assert st.get(job.id).lease is None
+
+    # w1's in-flight heartbeat carries the dead generation: refused,
+    # and nothing is resurrected
+    assert st.renew_lease(job.id, "w1", gen=gen1) is False
+    assert st.get(job.id).lease is None
+
+    # takeover starts a new generation; the zombie still can't renew
+    j2 = st.try_lease(job.id, "w2", ttl_s=60)
+    assert j2.lease["gen"] == gen1 + 1
+    expires2 = j2.lease["expires_ts"]
+    assert st.renew_lease(job.id, "w1", gen=gen1) is False
+    after = st.get(job.id)
+    assert after.lease["worker"] == "w2"
+    assert after.lease["expires_ts"] == expires2  # untouched
+
+    # the same-worker corner worker-identity checks cannot catch: w2's
+    # lease is reclaimed and w2 itself re-leases (gen 3); a heartbeat
+    # captured before the reclaim (gen 2) must still fail the CAS
+    _expire(st, job.id)
+    st.reclaim_expired(backoff_base_s=0.0)
+    j3 = st.try_lease(job.id, "w2", ttl_s=60)
+    assert j3.lease["gen"] == gen1 + 2
+    assert st.renew_lease(job.id, "w2", gen=gen1 + 1) is False
+    assert st.renew_lease(job.id, "w2", gen=j3.lease["gen"]) is True
+
+    # gen=None keeps the legacy worker-identity semantics
+    assert st.renew_lease(job.id, "w2") is True
+    assert st.renew_lease(job.id, "w1") is False
+
+
+def test_lease_generation_survives_the_doc_roundtrip(tmp_path):
+    """The generation is part of the persisted document (a restarted
+    worker or a second process sees the same fencing state), and old
+    pre-generation docs load with gen 0."""
+    st = JobStore(str(tmp_path))
+    job = st.submit(dict(ECHO_SPEC))
+    st.try_lease(job.id, "w1", ttl_s=60)
+    doc = json.load(open(st.job_path(job.id)))
+    assert doc["lease_gen"] == 1 and doc["lease"]["gen"] == 1
+
+    # a pre-fencing document: no lease_gen field, no lease["gen"]
+    doc.pop("lease_gen")
+    doc["lease"] = {"worker": "w0", "expires_ts": 1e12, "ttl_s": 60}
+    json.dump(doc, open(st.job_path(job.id), "w"))
+    old = st.get(job.id)
+    assert old.lease_gen == 0
+    # worker-identity renewal still works against the legacy lease
+    assert st.renew_lease(job.id, "w0", gen=0) is True
+    assert st.renew_lease(job.id, "w0", gen=1) is False
