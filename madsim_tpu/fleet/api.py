@@ -727,6 +727,14 @@ def serve(root: str, addr: str, port_file: Optional[str] = None,
                         f"worker {act['worker']} -> {act['outcome']} "
                         f"(attempt {act['attempt']})", flush=True,
                     )
+                # pay the O(n) index-healing scan here so the workers'
+                # poll path never has to: any job the queue log
+                # misrepresents (mirror append lost to a crash) gets a
+                # correction row
+                fixed = store.sync_queue_log()
+                if fixed:
+                    print(f"sweep: healed {fixed} stale queue-index "
+                          f"row(s)", flush=True)
             except Exception:  # the farm outlives a bad sweep pass
                 _LOG.exception("lease-reclamation sweep failed")
 

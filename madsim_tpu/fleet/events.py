@@ -57,8 +57,9 @@ EVENT_TYPES = (
     "submitted", "queued", "compiling", "running", "plateaued",
     "exhausted", "found", "shrunk", "filed", "cancelled", "failed",
     "quarantined",
-    # lease / scheduling milestones
-    "leased", "requeued", "degraded", "cancel_requested",
+    # lease / scheduling milestones ("fenced" = a write from a dead
+    # lease generation was rejected and counted, never merged)
+    "leased", "requeued", "degraded", "cancel_requested", "fenced",
     # progress milestones
     "batch_done", "plateau", "find", "shrink_started", "shrink_done",
 )

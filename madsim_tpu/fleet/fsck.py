@@ -14,7 +14,10 @@ somewhere inside a worker:
   does not match its owning job), `drifted` (a job doc whose spec no
   longer hashes to its recorded fingerprint), `stale-tmp` (an
   interrupted atomic write's tmp file), `torn-tail` (a JSONL feed
-  whose final line is cut) or `unknown`;
+  whose final line is cut), `stale-claim` (a claim file from a dead
+  lease generation — removed; the job flock is the authority),
+  `index-stale` (queue.log disagrees with the job docs — rebuilt from
+  the docs) or `unknown`;
 * with `fix` (the CLI default; `--dry-run` scans only), unreadable
   files are quarantined to `<name>.corrupt` and stale tmp files are
   removed, then the queue's state counts are rebuilt from the
@@ -67,6 +70,8 @@ FP_INCONSISTENT = "fingerprint-inconsistent"
 DRIFTED = "drifted"
 STALE_TMP = "stale-tmp"
 TORN_TAIL = "torn-tail"
+STALE_CLAIM = "stale-claim"
+INDEX_STALE = "index-stale"
 UNKNOWN = "unknown"
 
 #: verdicts that make a file unreadable — counted as corruption,
@@ -211,6 +216,43 @@ def _check_jsonl(path: str, finding: dict,
                        detail=f"unparseable lines {bad[:5]}")
 
 
+def _check_claim(path: str, fn: str, finding: dict, jobs_by_id: dict,
+                 now: float) -> None:
+    """A claim file is live iff it names the owning job's CURRENT
+    unexpired lease holder (and, when stamped, its generation). Any
+    other claim — torn stamp, dead generation, expired hold, no owning
+    doc — is advisory garbage a fixing fsck removes. Removal is always
+    safe: the per-job flock, not the claim, is the authoritative
+    arbiter, so the worst a wrongly-removed claim costs is one extra
+    lock round."""
+    text, err = _read(path)
+    if text is None:
+        finding.update(verdict=STALE_CLAIM, detail=err)
+        return
+    doc, verdict, _detail = _classify_json(text)
+    if verdict != OK or not isinstance(doc, dict):
+        finding.update(
+            verdict=STALE_CLAIM,
+            detail="torn claim stamp (crash mid-claim); the job flock "
+                   "arbitrates around it",
+        )
+        return
+    job = jobs_by_id.get(fn[: -len(".claim")])
+    lease = job.lease if job is not None else None
+    live = (
+        lease is not None
+        and lease.get("worker") == doc.get("worker")
+        and (lease.get("expires_ts") or 0) > now
+        and doc.get("gen") in (None, lease.get("gen"))
+    )
+    if not live:
+        finding.update(
+            verdict=STALE_CLAIM,
+            detail=f"claim by {doc.get('worker')!r} gen {doc.get('gen')} "
+                   f"does not match a live lease — dead generation",
+        )
+
+
 def _check_corpus(path: str, finding: dict) -> None:
     text, err = _read(path)
     if text is None:
@@ -242,6 +284,7 @@ def scan(store: JobStore) -> dict:
     probe."""
     findings: List[dict] = []
     jobs_by_id: dict = {}
+    now = time.time()
     names = sorted(os.listdir(store.jobs_dir))
     # job docs first: checkpoint fingerprint checks need their owners
     names.sort(key=lambda fn: 0 if fn.endswith(".json")
@@ -286,6 +329,8 @@ def scan(store: JobStore) -> dict:
                 _doc, verdict, detail = _classify_json(text)
                 if verdict != OK:
                     finding.update(verdict=verdict, detail=detail)
+        elif fn.endswith(".claim"):
+            _check_claim(path, fn, finding, jobs_by_id, now)
         elif fn.endswith(".json"):
             _check_job_doc(path, fn, finding, jobs_by_id)
         else:
@@ -299,15 +344,34 @@ def scan(store: JobStore) -> dict:
         _check_corpus(store.corpus_path, finding)
         if finding["verdict"] != OK:
             findings.append(finding)
+    # the log-structured queue index: torn tail (a crash mid-append —
+    # readers already skip it) and index/doc disagreement are both
+    # reported here; a fixing fsck rebuilds the log from the job docs,
+    # which stay the source of truth
+    qlag = 0
+    if os.path.exists(store.queue_log_path):
+        finding = {"path": store.queue_log_path, "file": "queue.log",
+                   "verdict": OK, "detail": "", "action": "none"}
+        _check_jsonl(store.queue_log_path, finding, torn_anywhere=True)
+        qlag = store.queue_log_lag()
+        if qlag:
+            lag_detail = (f"{qlag} job(s) misrepresented by the index "
+                          f"(doc state differs or row missing)")
+            if finding["verdict"] == OK:
+                finding.update(verdict=INDEX_STALE, detail=lag_detail)
+            else:
+                finding["detail"] += f"; {lag_detail}"
+        if finding["verdict"] != OK:
+            findings.append(finding)
 
     jobs = list(jobs_by_id.values())
     counts = {s: 0 for s in STATES}
     for j in jobs:
         counts[j.state] = counts.get(j.state, 0) + 1
-    now = time.time()
     return {
         "root": store.root,
-        "files_scanned": len(names) + int(os.path.exists(store.corpus_path)),
+        "files_scanned": (len(names) + int(os.path.exists(store.corpus_path))
+                          + int(os.path.exists(store.queue_log_path))),
         "findings": findings,
         "corrupt": sum(1 for f in findings
                        if f["verdict"] in CORRUPT_VERDICTS),
@@ -316,6 +380,9 @@ def scan(store: JobStore) -> dict:
                          if f["verdict"] == STALE_TMP),
         "torn_tails": sum(1 for f in findings
                           if f["verdict"] == TORN_TAIL),
+        "stale_claims": sum(1 for f in findings
+                            if f["verdict"] == STALE_CLAIM),
+        "queue_log_lag": qlag,
         "counts": {s: n for s, n in counts.items() if n},
         "jobs": len(jobs),
         "queue_depth": counts.get(QUEUED, 0),
@@ -340,8 +407,19 @@ def fsck(root: str, *, fix: bool = True, reclaim: bool = False,
     store = JobStore(root)
     report = scan(store)
     if fix:
+        rebuilt = False
         for finding in report["findings"]:
-            if finding["verdict"] in CORRUPT_VERDICTS:
+            if finding["file"] == "queue.log":
+                # torn tail or stale index, same repair: rewrite the
+                # log from the job documents (the source of truth)
+                if not rebuilt:
+                    n = store.rebuild_queue_log()
+                    rebuilt = True
+                    finding["action"] = f"rebuilt from {n} job doc(s)"
+            elif finding["verdict"] == STALE_CLAIM:
+                os.remove(finding["path"])
+                finding["action"] = "removed"
+            elif finding["verdict"] in CORRUPT_VERDICTS:
                 target = finding["path"] + ".corrupt"
                 os.replace(finding["path"], target)
                 finding["action"] = f"quarantined -> {target}"
@@ -393,6 +471,8 @@ def render(report: dict) -> str:
         f"{report['corrupt']} corrupt, {report['drifted']} drifted, "
         f"{report['stale_tmp']} stale tmp, "
         f"{report['torn_tails']} torn tails, "
+        f"{report.get('stale_claims', 0)} stale claims, "
+        f"queue-log lag {report.get('queue_log_lag', 0)}, "
         f"{report['stale_leases']} stale leases"
     )
     return "\n".join(lines)

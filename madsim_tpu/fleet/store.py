@@ -56,7 +56,12 @@ import time
 from types import SimpleNamespace
 from typing import Callable, Dict, List, Optional
 
-from ..runtime.atomicio import atomic_write_json
+from ..runtime.atomicio import (
+    append_text,
+    atomic_write_json,
+    atomic_write_text,
+    create_exclusive,
+)
 from ..runtime.checkpoint import fingerprint_from_args
 from . import events as fleet_events
 
@@ -339,6 +344,24 @@ class CorruptJobFile(RuntimeError):
         self.detail = detail
 
 
+class FencedWrite(RuntimeError):
+    """A store mutation carried a fencing token from a dead lease
+    generation: the job was reclaimed (and possibly re-leased) since
+    this worker last held it. The write was REJECTED and counted —
+    nothing of it was merged. The worker's only correct response is to
+    abandon the unit; the current holder owns the job now."""
+
+    def __init__(self, job_id: str, worker: str, gen: int, op: str):
+        super().__init__(
+            f"job {job_id}: {op} from {worker!r} gen {gen} rejected — "
+            f"lease was reclaimed; abandon the unit"
+        )
+        self.job_id = job_id
+        self.worker = worker
+        self.gen = gen
+        self.op = op
+
+
 @dataclasses.dataclass
 class Job:
     id: str
@@ -382,6 +405,12 @@ class Job:
     #: against it, so a reclaimed ("zombie") hold can never resurrect
     #: its lease or merge state the next holder doesn't expect.
     lease_gen: int = 0
+    #: observability-class tally (never feeds job results): store
+    #: writes rejected because they carried a dead lease generation.
+    #: Claim-race losses are counted worker-side (`workers/<id>.json`)
+    #: — the loser's whole point is to back off without taking the
+    #: job's lock.
+    n_fenced_writes: int = 0
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -411,13 +440,23 @@ class JobStore:
         jobs/<id>.device.trace.json.gz  worker device-profile capture
                                (MADSIM_TPU_XPROF=1 units only)
         jobs/<id>.vtrace.json  failing lane's virtual-time trace (ditto)
+        jobs/<id>.claim        O_EXCL claim file (contention arbiter;
+                               advisory — the flock stays authoritative)
         corpus.json            filed finds (corpus.CorpusEntry records)
+        queue.log              append-only queue index (rebuildable
+                               from the job docs; docs stay the truth)
+        workers/<id>.json      per-worker observability counters
     """
 
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
         self.jobs_dir = os.path.join(self.root, "jobs")
         os.makedirs(self.jobs_dir, exist_ok=True)
+        # in-memory materialization of queue.log: row per job, refreshed
+        # incrementally (stat + read-the-new-bytes) on every poll
+        self._qrows: Dict[str, dict] = {}
+        self._qlog_pos = 0
+        self._qlog_ino: Optional[int] = None
 
     # -- paths ---------------------------------------------------------------
 
@@ -448,9 +487,48 @@ class JobStore:
         gate as the device trace; times are simulated µs, never wall)."""
         return os.path.join(self.jobs_dir, f"{job_id}.vtrace.json")
 
+    def claim_path(self, job_id: str) -> str:
+        return os.path.join(self.jobs_dir, f"{job_id}.claim")
+
     @property
     def corpus_path(self) -> str:
         return os.path.join(self.root, "corpus.json")
+
+    @property
+    def queue_log_path(self) -> str:
+        return os.path.join(self.root, "queue.log")
+
+    @property
+    def workers_dir(self) -> str:
+        return os.path.join(self.root, "workers")
+
+    def worker_stats_path(self, worker_id: str) -> str:
+        if not re.fullmatch(r"[A-Za-z0-9._-]+", worker_id):
+            raise KeyError(f"malformed worker id {worker_id!r}")
+        return os.path.join(self.workers_dir, f"{worker_id}.json")
+
+    def write_worker_stats(self, worker_id: str, doc: dict) -> None:
+        """Per-worker observability counters (claim conflicts, fenced
+        writes, polls...). Throwaway-on-crash quality: no fsync, and
+        nothing in the store depends on them."""
+        os.makedirs(self.workers_dir, exist_ok=True)
+        atomic_write_json(self.worker_stats_path(worker_id), doc,
+                          fsync=False)
+
+    def read_worker_stats(self) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        try:
+            names = sorted(os.listdir(self.workers_dir))
+        except FileNotFoundError:
+            return out
+        for fn in names:
+            if not fn.endswith(".json"):
+                continue
+            with contextlib.suppress(OSError, json.JSONDecodeError,
+                                     UnicodeDecodeError):
+                with open(os.path.join(self.workers_dir, fn)) as f:
+                    out[fn[:-len(".json")]] = json.load(f)
+        return out
 
     # -- locking + atomic IO -------------------------------------------------
 
@@ -473,6 +551,135 @@ class JobStore:
         # previous document, and the chaos harness injects its torn
         # writes at exactly this point
         atomic_write_json(self.job_path(job.id), job.to_dict())
+        # mirror the queue-relevant fields into the append-only index
+        # log. Best-effort by design: the doc above is the source of
+        # truth, a missed or torn record only makes the index lag, and
+        # the sweep/fsck re-sync it. Appends from different jobs' locks
+        # interleave whole records (single O_APPEND write).
+        with contextlib.suppress(OSError):
+            append_text(self.queue_log_path,
+                        json.dumps(self._queue_record(job), sort_keys=True,
+                                   separators=(",", ":")) + "\n",
+                        fsync=False)
+
+    # -- the queue log (rebuildable index; the docs stay the truth) ----------
+
+    @staticmethod
+    def _queue_record(job: Job) -> dict:
+        """One queue-log row: exactly the fields a lease poll filters
+        and ranks on, so a reader answers "what can I claim?" without
+        touching any job document."""
+        lease = job.lease or {}
+        return {
+            "job": job.id,
+            "state": job.state,
+            "subkey": job.subkey,
+            "priority": job.priority,
+            "deadline_ts": job.deadline_ts,
+            "requeue_after_ts": job.requeue_after_ts,
+            "worker": lease.get("worker"),
+            "lease_expires_ts": lease.get("expires_ts"),
+            "gen": job.lease_gen,
+            "plateau": bool(job.progress.get("plateau")),
+            "ts": round(time.time(), 3),
+        }
+
+    def queue_rows(self) -> Dict[str, dict]:
+        """The in-memory queue index: job id -> latest queue-log row.
+        Refresh is O(new bytes): stat the log, read only what grew
+        since the last call, keep at most one unterminated tail line
+        unconsumed (it may be mid-append; the next append heals it).
+        Unparseable lines are skipped — same torn-tolerance contract as
+        the event-log readers. A store without a log yet (pre-index
+        farms) gets one built from the docs, so the NEXT poll is
+        O(1)."""
+        path = self.queue_log_path
+        try:
+            stt = os.stat(path)
+        except FileNotFoundError:
+            self.rebuild_queue_log()
+            try:
+                stt = os.stat(path)
+            except FileNotFoundError:  # pragma: no cover - read-only fs
+                return dict(self._qrows)
+        if stt.st_ino != self._qlog_ino or stt.st_size < self._qlog_pos:
+            # replaced (rebuild) or truncated (torn-tail repair): rescan
+            self._qrows, self._qlog_pos = {}, 0
+            self._qlog_ino = stt.st_ino
+        if stt.st_size > self._qlog_pos:
+            with open(path, "rb") as f:
+                f.seek(self._qlog_pos)
+                chunk = f.read()
+            cut = chunk.rfind(b"\n")
+            if cut >= 0:
+                for line in chunk[:cut].split(b"\n"):
+                    if not line.strip():
+                        continue
+                    try:
+                        row = json.loads(line)
+                        self._qrows[row["job"]] = row
+                    except (json.JSONDecodeError, UnicodeDecodeError,
+                            KeyError, TypeError):
+                        continue  # torn/foreign line: skip, never crash
+                self._qlog_pos += cut + 1
+        return self._qrows
+
+    def rebuild_queue_log(self) -> int:
+        """Write a fresh queue.log from the job documents (one row per
+        job, sorted ids) — the fsck repair and the lazy migration path
+        for stores that predate the log. Atomic replace, so concurrent
+        readers see either the old log or the new one."""
+        with self._locked(".store"):
+            jobs = self.list()
+            lines = [
+                json.dumps(self._queue_record(j), sort_keys=True,
+                           separators=(",", ":"))
+                for j in sorted(jobs, key=lambda j: j.id)
+            ]
+            text = "\n".join(lines) + ("\n" if lines else "")
+            atomic_write_text(self.queue_log_path, text, fsync=False)
+        self._qrows, self._qlog_pos, self._qlog_ino = {}, 0, None
+        return len(lines)
+
+    @staticmethod
+    def _row_stale(row: Optional[dict], job: "Job") -> bool:
+        """A row misrepresents its job when the poll-relevant fields —
+        state, lease holder, lease generation — disagree with the doc.
+        (A row showing a leased job as free sends every poller into a
+        claim conflict; state alone would miss that.)"""
+        if row is None:
+            return True
+        lease = job.lease or {}
+        return (row.get("state") != job.state
+                or row.get("worker") != lease.get("worker")
+                or row.get("gen", 0) != job.lease_gen)
+
+    def queue_log_lag(self) -> int:
+        """How many jobs the index currently misrepresents: doc state
+        or lease differs from (or is missing from) the log's last
+        word. O(n) — for sweeps, fsck and /healthz, never the poll
+        path."""
+        rows = self.queue_rows()
+        return sum(1 for job in self.list()
+                   if self._row_stale(rows.get(job.id), job))
+
+    def sync_queue_log(self) -> int:
+        """Append correction rows for any job the log misrepresents
+        (e.g. the doc write landed but the process died before the
+        mirror append). Called from the serve sweep and fsck — both
+        already pay the O(n) doc scan."""
+        rows = self.queue_rows()
+        fixed = 0
+        for job in self.list():
+            if self._row_stale(rows.get(job.id), job):
+                with contextlib.suppress(OSError):
+                    append_text(self.queue_log_path,
+                                json.dumps(self._queue_record(job),
+                                           sort_keys=True,
+                                           separators=(",", ":")) + "\n",
+                                fsync=False)
+                fixed += 1
+        return fixed
 
     # -- submit / read -------------------------------------------------------
 
@@ -592,16 +799,44 @@ class JobStore:
                 self._emit(job_id, pending_events)
         return job
 
+    def _fenced(self, job: Job, worker: Optional[str], gen: Optional[int],
+                op: str, ev: List[dict]) -> bool:
+        """The fence: a mutation carrying a token (worker, gen) goes
+        through only while that exact generation is the live lease.
+        Rejections are counted on the document and logged as a `fenced`
+        event — observability, never results — and the caller raises
+        FencedWrite so the zombie learns it lost the job. No token
+        (gen None) means an operator/supervisor mutation: not fenced."""
+        if gen is None:
+            return False
+        lease = job.lease
+        if lease and lease["worker"] == worker and lease.get("gen", 0) == gen:
+            return False
+        job.n_fenced_writes += 1
+        ev.append({"type": "fenced", "worker": worker, "gen": gen,
+                   "op": op, "holder": lease["worker"] if lease else None,
+                   "holder_gen": job.lease_gen})
+        return True
+
     def transition(self, job_id: str, to: str, *, error: Optional[str] = None,
                    result: Optional[dict] = None,
-                   progress: Optional[dict] = None) -> Job:
-        """Move a job along the lifecycle; illegal edges raise."""
+                   progress: Optional[dict] = None,
+                   worker: Optional[str] = None,
+                   gen: Optional[int] = None) -> Job:
+        """Move a job along the lifecycle; illegal edges raise. When
+        the caller holds a lease it passes its fencing token (worker,
+        gen): a reclaimed generation's transition raises FencedWrite
+        and mutates nothing but the rejection counter."""
         if to not in STATES:
             raise ValueError(f"unknown state {to!r}")
 
         ev: List[dict] = []
+        fenced: List[bool] = [False]
 
         def mut(job: Job) -> None:
+            if self._fenced(job, worker, gen, f"transition->{to}", ev):
+                fenced[0] = True
+                return
             if to not in _TRANSITIONS[job.state]:
                 raise ValueError(
                     f"illegal transition {job.state} -> {to} for {job.id}"
@@ -623,7 +858,12 @@ class JobStore:
             if to in TERMINAL:
                 job.lease = None
 
-        return self._update(job_id, mut, ev)
+        out = self._update(job_id, mut, ev)
+        if fenced[0]:
+            raise FencedWrite(job_id, worker or "?", gen, f"transition->{to}")
+        if to in TERMINAL:
+            self._clear_claim(job_id)
+        return out
 
     def request_cancel(self, job_id: str) -> Job:
         """Queued jobs cancel immediately; in-flight jobs get the flag
@@ -643,18 +883,69 @@ class JobStore:
             else:
                 ev.append({"type": "cancel_requested"})
 
-        return self._update(job_id, mut, ev)
+        out = self._update(job_id, mut, ev)
+        if out.terminal:
+            self._clear_claim(job_id)
+        return out
 
     # -- leases --------------------------------------------------------------
 
-    def try_lease(self, job_id: str, worker: str, ttl_s: float) -> Optional[Job]:
+    def _read_claim(self, job_id: str) -> Optional[dict]:
+        try:
+            with open(self.claim_path(job_id)) as f:
+                doc = json.loads(f.read())
+            return doc if isinstance(doc, dict) else None
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            return None
+
+    def _clear_claim(self, job_id: str) -> None:
+        with contextlib.suppress(OSError):
+            os.unlink(self.claim_path(job_id))
+
+    def try_lease(self, job_id: str, worker: str, ttl_s: float, *,
+                  info: Optional[dict] = None) -> Optional[Job]:
         """Claim (or renew/reclaim) a job for `worker`. Returns the job
         when the lease is held, None when another worker's unexpired
         lease blocks it or the job is in requeue backoff. A worker
         always reclaims its OWN lease immediately (restart-after-
-        SIGKILL without waiting out the ttl)."""
+        SIGKILL without waiting out the ttl).
+
+        Contention discipline: a `jobs/<id>.claim` file created
+        O_EXCL-style arbitrates N workers racing the same pick — the
+        kernel picks exactly one winner and every loser returns None
+        *without taking the job's lock* (`info["outcome"] ==
+        "claim-conflict"`; the caller backs off with seeded jitter). A
+        claim whose holder no longer has a live lease on the doc is
+        dead weight (crashed claimant or reclaimed generation): the
+        contender falls through to the flock, which stays the
+        authoritative arbiter, and overwrites it on success. `info`,
+        when passed, receives the outcome for the caller's counters."""
         now = time.time()
         claimed: List[Optional[Job]] = [None]
+        claim = self.claim_path(job_id)
+        won_create = create_exclusive(
+            claim,
+            json.dumps({"worker": worker, "ts": round(now, 3)},
+                       sort_keys=True) + "\n",
+            fsync=False,
+        )
+        if not won_create:
+            holder = self._read_claim(job_id)
+            if holder and holder.get("worker") not in (None, worker):
+                try:
+                    cur: Optional[Job] = self.get(job_id)
+                except (KeyError, CorruptJobFile):
+                    cur = None
+                lease = cur.lease if cur else None
+                if (lease and lease["worker"] == holder.get("worker")
+                        and lease["expires_ts"] > now):
+                    # live claim, live lease: a genuine race lost
+                    if info is not None:
+                        info["outcome"] = "claim-conflict"
+                        info["holder"] = lease["worker"]
+                    return None
+                # stale claim (dead generation / claimant died between
+                # claim and lease): arbitrate under the lock below
 
         def mut(job: Job) -> None:
             if job.state not in LEASABLE:
@@ -683,8 +974,34 @@ class JobStore:
             claimed[0] = job
 
         ev: List[dict] = []
-        self._update(job_id, mut, ev)
-        return claimed[0]
+        try:
+            self._update(job_id, mut, ev)
+        except (KeyError, CorruptJobFile):
+            if won_create:
+                self._clear_claim(job_id)
+            raise
+        got = claimed[0]
+        if got is not None:
+            # stamp the claim with the winning hold (atomic replace —
+            # the O_EXCL race is settled once the lease is on the doc);
+            # fsck judges claim staleness by this generation
+            atomic_write_text(
+                claim,
+                json.dumps({"worker": worker, "gen": got.lease_gen,
+                            "expires_ts": got.lease["expires_ts"]},
+                           sort_keys=True) + "\n",
+                fsync=False,
+            )
+            if info is not None:
+                info["outcome"] = "leased"
+        else:
+            if won_create:
+                # we arbitrated the claim but the doc said no (backoff,
+                # terminal, foreign lease): leave nothing behind
+                self._clear_claim(job_id)
+            if info is not None:
+                info.setdefault("outcome", "not-leasable")
+        return got
 
     def renew_lease(self, job_id: str, worker: str,
                     gen: Optional[int] = None) -> bool:
@@ -714,17 +1031,28 @@ class JobStore:
     # -- deaths, requeue, quarantine -----------------------------------------
 
     def note_progress(self, job_id: str, worker: str, progress: dict,
-                      event_fields: Optional[dict] = None) -> Job:
+                      event_fields: Optional[dict] = None,
+                      gen: Optional[int] = None) -> Job:
         """A unit completed: merge progress, reset the consecutive-
         failure counter (deaths are only poison when consecutive) and
         renew the lease — one locked write, so the worker's per-unit
         store-write sequence stays deterministic for the chaos
         harness's write counter. `event_fields` carries the worker's
         batch telemetry (seeds/s, elapsed, device count) into the
-        `batch_done` event."""
+        `batch_done` event.
+
+        `gen` is the worker's fencing token: a reclaimed generation's
+        progress raises FencedWrite and merges NOTHING — a zombie must
+        not resurrect the lease, reset the attempt counter, or clobber
+        the current holder's progress. Pre-fencing callers (gen None)
+        keep the worker-identity-only lease renewal."""
         ev: List[dict] = []
+        fenced: List[bool] = [False]
 
         def mut(job: Job) -> None:
+            if self._fenced(job, worker, gen, "note_progress", ev):
+                fenced[0] = True
+                return
             was_plateau = bool(job.progress.get("plateau"))
             job.progress = {**job.progress, **progress}
             job.attempt = 0
@@ -738,13 +1066,18 @@ class JobStore:
                    "coverage_slots": job.progress.get("coverage_slots"),
                    "escalation": job.progress.get("escalation"),
                    "failing": job.progress.get("failing")}
+            if job.lease:
+                rec["gen"] = job.lease.get("gen", 0)
             rec.update(event_fields or {})
             ev.append(rec)
             if not was_plateau and bool(job.progress.get("plateau")):
                 ev.append({"type": "plateau", "worker": worker,
                            "batch": job.progress.get("batches_run")})
 
-        return self._update(job_id, mut, ev)
+        out = self._update(job_id, mut, ev)
+        if fenced[0]:
+            raise FencedWrite(job_id, worker, gen, "note_progress")
+        return out
 
     def record_death(self, job_id: str, *, reason: str,
                      worker: Optional[str] = None,
@@ -753,7 +1086,8 @@ class JobStore:
                      max_attempts: int = MAX_ATTEMPTS,
                      backoff_base_s: float = REQUEUE_BACKOFF_BASE_S,
                      lease_reclaim: bool = False,
-                     require_expired_lease: bool = False) -> Optional[Job]:
+                     require_expired_lease: bool = False,
+                     gen: Optional[int] = None) -> Optional[Job]:
         """One worker death (expired lease) or worker-reported hard
         failure on this job: bump the consecutive-attempt counter and
         either requeue with exponential backoff — checkpoint preserved,
@@ -761,11 +1095,19 @@ class JobStore:
         `max_attempts`, quarantine with the full post-mortem (last
         exception, batch index, repro command). Returns the updated job,
         or None when the guarded re-check made this a no-op (e.g. the
-        lease was renewed between the sweep's scan and the lock)."""
+        lease was renewed between the sweep's scan and the lock).
+
+        A worker SELF-reporting a failure passes its fencing token:
+        a zombie's death report from a dead generation must not clear
+        the current holder's lease or burn an attempt on a job someone
+        else is running — it is counted and dropped (returns None,
+        no raise: the reporter was abandoning the job anyway)."""
         now = time.time()
         done: List[Optional[Job]] = [None]
 
         def mut(job: Job) -> None:
+            if self._fenced(job, worker, gen, "record_death", ev):
+                return
             if job.state not in LEASABLE:
                 return
             if require_expired_lease and not (
@@ -822,36 +1164,63 @@ class JobStore:
 
         ev: List[dict] = []
         self._update(job_id, mut, ev)
+        if done[0] is not None:
+            self._clear_claim(job_id)  # the lease is gone either way
         return done[0]
 
     def reclaim_expired(self, *, max_attempts: int = MAX_ATTEMPTS,
-                        backoff_base_s: float = REQUEUE_BACKOFF_BASE_S
-                        ) -> List[dict]:
+                        backoff_base_s: float = REQUEUE_BACKOFF_BASE_S,
+                        via_index: bool = False) -> List[dict]:
         """The supervisor sweep: every non-terminal job whose worker
         lease expired is a worker death — requeue it (or quarantine at
         the attempt cap) via `record_death`. Runs in `fleet serve`'s
         sweep thread, in `fleet fsck --reclaim`, and at the top of every
         worker lease poll, so a farm with ANY live component reclaims.
-        Returns one action record per reclaimed job."""
+        Returns one action record per reclaimed job.
+
+        `via_index=True` sweeps from the queue-log index instead of
+        re-reading every document — the worker-poll variant, O(1) when
+        nothing expired. Safe against a lagging index: `record_death`
+        re-validates the expiry under the job's lock, so a stale row
+        is a no-op (a MISSING row is healed by the serve sweep's
+        `sync_queue_log`, which runs the full-scan variant)."""
         now = time.time()
         actions = []
-        for job in self.list():
+        if via_index:
+            sweep = [
+                SimpleNamespace(
+                    id=row["job"], state=row.get("state"),
+                    lease=(
+                        {"worker": row.get("worker"),
+                         "expires_ts": row.get("lease_expires_ts")}
+                        if row.get("worker") else None
+                    ),
+                    error=None,
+                )
+                for row in list(self.queue_rows().values())
+            ]
+        else:
+            sweep = self.list()
+        for job in sweep:
             if job.state not in LEASABLE or not job.lease:
                 continue
-            if job.lease["expires_ts"] > now:
+            if (job.lease["expires_ts"] or 0) > now:
                 continue
             dead_worker = job.lease["worker"]
-            out = self.record_death(
-                job.id,
-                reason="lease expired",
-                worker=dead_worker,
-                error=job.error,
-                batch_index=self._ckpt_batch(job.id),
-                max_attempts=max_attempts,
-                backoff_base_s=backoff_base_s,
-                lease_reclaim=True,
-                require_expired_lease=True,
-            )
+            try:
+                out = self.record_death(
+                    job.id,
+                    reason="lease expired",
+                    worker=dead_worker,
+                    error=job.error,
+                    batch_index=self._ckpt_batch(job.id),
+                    max_attempts=max_attempts,
+                    backoff_base_s=backoff_base_s,
+                    lease_reclaim=True,
+                    require_expired_lease=True,
+                )
+            except (KeyError, CorruptJobFile):
+                continue  # index row outlived its doc: fsck's problem
             if out is not None:
                 actions.append({
                     "job": out.id,
@@ -886,7 +1255,8 @@ class JobStore:
         return self._update(job_id, mut, ev)
 
     def degrade_lanes(self, job_id: str, *, error: str,
-                      worker: Optional[str] = None) -> Job:
+                      worker: Optional[str] = None,
+                      gen: Optional[int] = None) -> Job:
         """OOM lane-count backoff: halve the job's `batch` and requeue
         it, instead of burning attempts on a shape that cannot
         allocate. `batch` is a fingerprint field, so the fingerprint /
@@ -898,8 +1268,12 @@ class JobStore:
         `job.degraded`."""
         new_batch: List[int] = [0]
         ev: List[dict] = []
+        fenced: List[bool] = [False]
 
         def mut(job: Job) -> None:
+            if self._fenced(job, worker, gen, "degrade_lanes", ev):
+                fenced[0] = True
+                return
             if job.terminal:
                 return
             nb = max(1, job.spec["batch"] // 2)
@@ -927,6 +1301,9 @@ class JobStore:
                        "worker": worker})
 
         out = self._update(job_id, mut, ev)
+        if fenced[0]:
+            raise FencedWrite(job_id, worker or "?", gen, "degrade_lanes")
+        self._clear_claim(job_id)  # requeued: the hold is over
         with contextlib.suppress(OSError):
             os.remove(self.ckpt_path(job_id))
         return out

@@ -62,10 +62,12 @@ from __future__ import annotations
 # idle polling and per-unit throughput logs. Nothing feeds simulation
 # state; a job's results are a pure function of (fingerprint, seed
 # schedule).
+import contextlib
 import importlib
 import json
 import logging
 import os
+import random
 import time
 from typing import Callable, List, Optional, Tuple
 
@@ -85,6 +87,8 @@ from .store import (
     REQUEUE_BACKOFF_BASE_S,
     RUNNING,
     SHRUNK,
+    CorruptJobFile,
+    FencedWrite,
     Job,
     JobStore,
     engine_key,
@@ -130,6 +134,36 @@ class FleetWorker:
         self.reclaim = reclaim
         self._engines: dict = {}          # engine_key -> Engine
         self._engine_subkey: Optional[str] = None
+        #: fencing token for the unit in flight: the lease generation
+        #: captured at claim time and threaded through every store
+        #: mutation this worker makes for that job, so a write from a
+        #: reclaimed (zombie) hold is refused instead of applied
+        self._unit_gen: Optional[int] = None
+        #: contention counters, mirrored to workers/<id>.json so the
+        #: control plane (`fleet top`, /healthz, /metrics) can report
+        #: per-worker claim-conflict and fenced-write tallies without
+        #: ever taking a job lock
+        self.claim_conflicts = 0
+        self.fenced_writes = 0
+        self.units_done = 0
+
+    def _note_fenced(self, exc: "FencedWrite") -> None:
+        """Count and surface a refused zombie write, then move on —
+        abandoning the unit IS the correct recovery (the store already
+        kept the new holder's state intact)."""
+        self.fenced_writes += 1
+        self._write_stats()
+        print(f"worker {self.worker_id}: {exc}", flush=True)
+
+    def _write_stats(self) -> None:
+        with contextlib.suppress(OSError, ValueError):
+            self.store.write_worker_stats(self.worker_id, {
+                "worker": self.worker_id,
+                "claim_conflicts": self.claim_conflicts,
+                "fenced_writes": self.fenced_writes,
+                "units_done": self.units_done,
+                "ts": round(time.time(), 3),
+            })
 
     # -- main loop -----------------------------------------------------------
 
@@ -147,7 +181,10 @@ class FleetWorker:
                 time.sleep(self.poll_s)
                 continue
             self._run_unit(job)
+            self._unit_gen = None  # token never outlives its unit
             units += 1
+            self.units_done = units
+            self._write_stats()
             if max_units and units >= max_units:
                 print(
                     f"worker {self.worker_id}: stopping after "
@@ -160,6 +197,7 @@ class FleetWorker:
             for act in self.store.reclaim_expired(
                 max_attempts=self.max_attempts,
                 backoff_base_s=self.backoff_base_s,
+                via_index=True,
             ):
                 print(
                     f"reclaimed {act['job']} from dead worker "
@@ -167,16 +205,35 @@ class FleetWorker:
                     f"(attempt {act['attempt']})", flush=True,
                 )
         now = time.time()
+        # candidate filtering runs on the log-structured queue index:
+        # one incremental read of queue.log's new tail, zero per-job
+        # document opens for jobs the index already rules out. The
+        # index is a hint, not an authority — survivors get their real
+        # document re-checked, and `try_lease` arbitrates under the
+        # job's lock anyway.
         cands = []
-        for j in self.store.list():
+        for jid, row in sorted(self.store.queue_rows().items()):
+            if row.get("state") not in LEASABLE:
+                continue
+            after = row.get("requeue_after_ts")
+            if after and after > now:
+                continue  # requeue backoff still running
+            holder = row.get("worker")
+            if (holder and holder != self.worker_id
+                    and (row.get("lease_expires_ts") or 0) > now):
+                continue  # someone else is (still) on it
+            try:
+                j = self.store.get(jid)
+            except (KeyError, CorruptJobFile):
+                continue  # stale index row; the serve sweep heals it
             if j.state not in LEASABLE:
                 continue
             if j.requeue_after_ts and j.requeue_after_ts > now:
-                continue  # requeue backoff still running
+                continue
             lease = j.lease
             if (lease and lease["worker"] != self.worker_id
                     and lease["expires_ts"] > now):
-                continue  # someone else is (still) on it
+                continue
             cands.append(j)
         # coverage-feedback reallocation: one momentum read per
         # candidate (its stats feed tail + progress mirror), so the
@@ -186,7 +243,27 @@ class FleetWorker:
         picked = self.alloc.pick(cands, momentum=momentum_for(self.store, cands))
         if picked is None:
             return None
-        return self.store.try_lease(picked.id, self.worker_id, self.lease_ttl_s)
+        info: dict = {}
+        got = self.store.try_lease(
+            picked.id, self.worker_id, self.lease_ttl_s, info=info)
+        if got is not None:
+            self._unit_gen = (got.lease or {}).get("gen")
+            return got
+        if info.get("outcome") == "claim-conflict":
+            # lost the O_EXCL race: count it, tell the control plane,
+            # and back off with seeded jitter so N losers do not
+            # re-collide on the very next poll
+            self.claim_conflicts += 1
+            self._write_stats()
+            print(
+                f"worker {self.worker_id}: lost claim race for "
+                f"{picked.id} to {info.get('holder')}", flush=True,
+            )
+            rng = random.Random(
+                f"fleet-claim {self.worker_id} {picked.id} "
+                f"{self.claim_conflicts}")
+            time.sleep(min(self.poll_s, 0.05) * (0.5 + rng.random()))
+        return None
 
     # -- one work unit -------------------------------------------------------
 
@@ -200,6 +277,14 @@ class FleetWorker:
         from ..perf.recorder import PerfRecorder, current_recorder
 
         job = self.store.get(job.id)  # freshest doc (cancel flag, spec)
+        lease = job.lease
+        if lease and lease.get("worker") == self.worker_id:
+            # fence token for every mutation this unit makes: the
+            # generation of our OWN live hold at unit start. A job
+            # entered without a lease (tests drive `_run_unit`
+            # directly) keeps gen None — the store's legacy unfenced
+            # semantics.
+            self._unit_gen = lease.get("gen")
         # per-unit recorder: the job id doubles as the trace id, and
         # `wall_t0` anchors the recorder's perf_counter clock on the
         # wall clock so the control plane can merge these spans with
@@ -253,11 +338,24 @@ class FleetWorker:
             # other contract violations) via sys.exit — deterministic
             # refusals, so retrying is pointless: surfaced verbatim as
             # the job's failed reason
-            self._fail(job, str(exc) or "worker aborted (SystemExit)")
+            try:
+                self._fail(job, str(exc) or "worker aborted (SystemExit)")
+            except FencedWrite as fexc:
+                self._note_fenced(fexc)
         except KeyboardInterrupt:
             raise
+        except FencedWrite as exc:
+            # the lease was reclaimed out from under this unit and the
+            # store refused the zombie's write — the job belongs to a
+            # newer generation now. Abandon the unit WITHOUT touching
+            # the store again: _hard_failure's record_death would stomp
+            # the new holder's lease.
+            self._note_fenced(exc)
         except Exception as exc:  # one broken job must not kill the farm
-            self._hard_failure(job, exc)
+            try:
+                self._hard_failure(job, exc)
+            except FencedWrite as fexc:
+                self._note_fenced(fexc)
         finally:
             atexit.unregister(_flush)
             if prev_term is not None:
@@ -347,7 +445,9 @@ class FleetWorker:
 
     def _stream_one_batch(self, job: Job, ck: Optional[dict]) -> None:
         if job.state == QUEUED:
-            job = self.store.transition(job.id, COMPILING)
+            job = self.store.transition(job.id, COMPILING,
+                                        worker=self.worker_id,
+                                        gen=self._unit_gen)
         t0 = time.perf_counter()
         batches_done = int(ck["batch"]) if ck else 0
         args = spec_to_args(
@@ -367,7 +467,9 @@ class FleetWorker:
             _stream_batches(eng, args, purpose="fleet")
             engine_label = "built" if built else "cached"
         if job.state == COMPILING:
-            job = self.store.transition(job.id, RUNNING)
+            job = self.store.transition(job.id, RUNNING,
+                                        worker=self.worker_id,
+                                        gen=self._unit_gen)
         prev_failing = int(job.progress.get("failing") or 0)
         ck = self._load_ckpt(job)
         progress = self._progress_from_ckpt(eng, ck)
@@ -378,6 +480,7 @@ class FleetWorker:
         # failure counter (this unit completed), renew the lease
         job = self.store.note_progress(
             job.id, self.worker_id, progress,
+            gen=self._unit_gen,
             event_fields={
                 "elapsed_s": round(el, 3),
                 "seeds_per_sec": round(job.spec["batch"] / el, 1)
@@ -489,7 +592,8 @@ class FleetWorker:
         ck = self._load_ckpt(job)
         report = self._report_from_ckpt(ck, "cancelled")
         self.store.transition(
-            job.id, CANCELLED, result={"report": report, "finds": []}
+            job.id, CANCELLED, result={"report": report, "finds": []},
+            worker=self.worker_id, gen=self._unit_gen,
         )
         print(f"job {job.id}: cancelled "
               f"({report['completed']} seeds run)", flush=True)
@@ -547,20 +651,25 @@ class FleetWorker:
             )
         if job.state == QUEUED:
             # deadline hit before the first unit ever ran
-            job = self.store.transition(job.id, COMPILING)
+            job = self.store.transition(job.id, COMPILING,
+                                        worker=self.worker_id,
+                                        gen=self._unit_gen)
         if job.state == COMPILING:
-            job = self.store.transition(job.id, RUNNING)
+            job = self.store.transition(job.id, RUNNING,
+                                        worker=self.worker_id,
+                                        gen=self._unit_gen)
         if not failing:
             final = PLATEAUED if stop_reason == "plateau" else EXHAUSTED
             self.store.transition(
-                job.id, final, result={"report": report, "finds": []}
+                job.id, final, result={"report": report, "finds": []},
+                worker=self.worker_id, gen=self._unit_gen,
             )
             print(f"job {job.id}: {final} ({report['completed']} seeds, "
                   f"0 failing, stop={stop_reason})", flush=True)
             return
         job = self.store.transition(job.id, FOUND, progress={
             "failing": len(failing),
-        })
+        }, worker=self.worker_id, gen=self._unit_gen)
         self.store.emit_job_event(
             job.id, "shrink_started", worker=self.worker_id,
             failing=len(failing))
@@ -581,7 +690,9 @@ class FleetWorker:
             self.store.emit_job_event(
                 job.id, "shrink_done", worker=self.worker_id,
                 finds=len(finds))
-            job = self.store.transition(job.id, SHRUNK)
+            job = self.store.transition(job.id, SHRUNK,
+                                        worker=self.worker_id,
+                                        gen=self._unit_gen)
             filed = 0
         else:
             eng, _built = self._get_engine(job)
@@ -590,14 +701,16 @@ class FleetWorker:
             self.store.emit_job_event(
                 job.id, "shrink_done", worker=self.worker_id,
                 finds=len(finds))
-            job = self.store.transition(job.id, SHRUNK)
+            job = self.store.transition(job.id, SHRUNK,
+                                        worker=self.worker_id,
+                                        gen=self._unit_gen)
             filed = self._file_finds(job, finds)
         self.store.transition(job.id, FILED, result={
             "report": report,
             "finds": finds,
             "corpus": self.store.corpus_path,
             "corpus_added": filed,
-        })
+        }, worker=self.worker_id, gen=self._unit_gen)
         print(
             f"job {job.id}: filed {filed} corpus entr"
             f"{'y' if filed == 1 else 'ies'} from {len(failing)} failing "
@@ -769,7 +882,9 @@ class FleetWorker:
         print(f"job {job.id}: FAILED — {reason}", flush=True)
         job = self.store.get(job.id)
         if job.state in (QUEUED, COMPILING, RUNNING, FOUND, SHRUNK):
-            self.store.transition(job.id, FAILED, error=reason)
+            self.store.transition(job.id, FAILED, error=reason,
+                                  worker=self.worker_id,
+                                  gen=self._unit_gen)
 
     @staticmethod
     def _is_oom(exc: BaseException) -> bool:
@@ -790,7 +905,8 @@ class FleetWorker:
         batch_index = self.store._ckpt_batch(job.id)
         if self._is_oom(exc) and job.spec["batch"] > MIN_DEGRADED_BATCH:
             out = self.store.degrade_lanes(
-                job.id, error=err, worker=self.worker_id
+                job.id, error=err, worker=self.worker_id,
+                gen=self._unit_gen,
             )
             # the OOMing shape's engine may be the allocation itself —
             # drop the live cache before the smaller shape compiles
@@ -811,6 +927,7 @@ class FleetWorker:
             batch_index=batch_index,
             max_attempts=self.max_attempts,
             backoff_base_s=self.backoff_base_s,
+            gen=self._unit_gen,
         )
         if out is None:
             return  # raced a concurrent transition; nothing to record

@@ -167,6 +167,12 @@ def append_text(path: str, text: str, *, fsync: bool = True) -> None:
     record from corrupting its successor, an append onto a file whose
     last byte is not a newline first heals the boundary with ``"\\n"``
     so the damage stays confined to its own line.
+
+    Concurrent appenders (N workers sharing one queue/event log) rely
+    on one more property: the heal byte and the record go down in a
+    SINGLE ``os.write`` on an ``O_APPEND`` descriptor, so two processes
+    appending at once interleave whole records, never bytes of one
+    record inside another.
     """
     _chaos_tick_append(path, text)
     heal = False
@@ -178,11 +184,38 @@ def append_text(path: str, text: str, *, fsync: bool = True) -> None:
                 heal = f.read(1) != b"\n"
     except FileNotFoundError:
         pass
-    with open(path, "a") as f:
-        f.write(("\n" if heal else "") + text)
+    data = (("\n" if heal else "") + text).encode()
+    fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o666)
+    try:
+        os.write(fd, data)
         if fsync:
-            f.flush()
-            os.fsync(f.fileno())
+            os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def create_exclusive(path: str, text: str, *, fsync: bool = True) -> bool:
+    """Create `path` with `text` iff it does not already exist
+    (``O_CREAT|O_EXCL``) — the kernel arbitrates, so exactly one of N
+    racing processes wins. Returns True for the winner, False when the
+    file already existed (the loser backs off; nothing is written).
+    This is the fleet claim-file primitive: claim creates go through
+    the chaos write counter like every other durable write, so a plan
+    matched on ``.claim`` can kill a contender at its k-th claim."""
+    _chaos_tick(path, text)
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o666)
+    except FileExistsError:
+        return False
+    try:
+        os.write(fd, text.encode())
+        if fsync:
+            os.fsync(fd)
+    finally:
+        os.close(fd)
+    if fsync:
+        fsync_dir(os.path.dirname(os.path.abspath(path)))
+    return True
 
 
 def atomic_write_json(path: str, doc, *, indent: int = 1,

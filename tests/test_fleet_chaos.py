@@ -338,6 +338,77 @@ def test_fsck_fix_quarantines_sweeps_and_rebuilds(tmp_path):
     assert os.path.exists(st.job_path(ok_job.id))
 
 
+def test_torn_queue_log_and_claim_files_table(tmp_path):
+    """The multi-worker artifacts join the torn table: queue.log and a
+    live claim file cut at every JSON-structural boundary. Neither cut
+    is EVER corruption — the index reader consumes only committed
+    lines (docs stay the source of truth; fsck rebuilds the log from
+    them), and a torn claim is arbitrated around by the job flock
+    (fsck removes it) — so /healthz stays green through the whole
+    sweep."""
+    root = str(tmp_path / "farm")
+    st = JobStore(root)
+    api = FleetAPI(st)
+    jobs = [st.submit(dict(ECHO)) for _ in range(3)]
+    held = st.try_lease(jobs[0].id, "w1", ttl_s=3600)
+    assert held is not None
+
+    qlog = st.queue_log_path
+    claim = st.claim_path(jobs[0].id)
+    pristine = {p: open(p).read() for p in (qlog, claim)}
+    # every committed queue row a prefix can expose, keyed by job
+    legit = {}
+    for line in pristine[qlog].splitlines():
+        legit.setdefault(json.loads(line)["job"], []).append(
+            json.loads(line))
+
+    checked = 0
+    for cut in _boundaries(pristine[qlog]):
+        with open(qlog, "w") as f:
+            f.write(pristine[qlog][:cut])
+        rep = fsck_mod.scan(st)
+        [finding] = [x for x in rep["findings"] if x["path"] == qlog]
+        assert finding["verdict"] in ("torn-tail", "index-stale"), (
+            cut, finding)
+        assert rep["corrupt"] == 0
+        # reader survival: a FRESH index (new process) materializes
+        # only committed rows, each byte-identical to a real append
+        rows = JobStore(root).queue_rows()
+        for jid, row in rows.items():
+            assert row in legit[jid], (cut, jid)
+        status, _, _ = api.handle("GET", "/healthz")
+        assert status == 200
+        checked += 1
+    with open(qlog, "w") as f:
+        f.write(pristine[qlog])
+
+    for cut in _boundaries(pristine[claim]):
+        with open(claim, "w") as f:
+            f.write(pristine[claim][:cut])
+        rep = fsck_mod.scan(st)
+        [finding] = [x for x in rep["findings"] if x["path"] == claim]
+        assert finding["verdict"] == "stale-claim", (cut, finding)
+        assert rep["corrupt"] == 0
+        # reader survival: the torn claim neither crashes a contender
+        # nor lets it steal w1's live lease (the flock arbitrates)
+        assert st.try_lease(jobs[0].id, "w9", ttl_s=60) is None
+        status, _, _ = api.handle("GET", "/healthz")
+        assert status == 200
+        checked += 1
+    assert checked > 100  # the table really swept the boundary space
+
+    # a fixing fsck heals both: log rebuilt from docs, torn claim gone
+    with open(qlog, "w") as f:
+        f.write(pristine[qlog][:37])
+    with open(claim, "w") as f:
+        f.write(pristine[claim][:10])
+    rep = fsck_mod.fsck(root, fix=True)
+    acts = {x["file"]: x["action"] for x in rep["findings"]}
+    assert acts["queue.log"].startswith("rebuilt from 3")
+    assert acts[f"{jobs[0].id}.claim"] == "removed"
+    assert JobStore(root).queue_log_lag() == 0
+
+
 def test_fsck_cli_exit_codes_and_json(tmp_path):
     from madsim_tpu.__main__ import main
 
