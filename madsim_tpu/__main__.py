@@ -1548,11 +1548,28 @@ def _fleet_top_render(doc: dict) -> str:
     head = "fleet top — " + " ".join(
         f"{k}:{counts[k]}" for k in sorted(counts) if counts[k]
     ) if counts else "fleet top — queue empty"
+    if doc.get("degraded"):
+        head += "  [DEGRADED: index-served while load-shedding]"
     cols = (f"{'JOB':<14} {'STATE':<11} {'MACHINE':<18} {'BATCH':>7} "
             f"{'FAIL':>4} {'SLOTS':>6} {'RUNG':>4} {'MOM':>3} "
             f"{'WORKER':<10} LAST EVENT")
     jobs = doc.get("jobs", [])
-    lines = [head] + ([cols] if jobs else [])
+    lines = [head]
+    farm = doc.get("farm")
+    if farm:
+        # the contention plane: shed state, index honesty, and each
+        # worker's lost claim races / refused zombie writes
+        bits = [f"shed:{'YES' if farm.get('shed') else 'no'}"]
+        if farm.get("queue_log_lag") is not None:
+            bits.append(f"lag:{farm['queue_log_lag']}")
+        for wid, ws in sorted((farm.get("workers") or {}).items()):
+            bits.append(
+                f"{wid}[units:{ws.get('units_done', 0)} "
+                f"conflicts:{ws.get('claim_conflicts', 0)} "
+                f"fenced:{ws.get('fenced_writes', 0)}]"
+            )
+        lines.append("farm — " + " ".join(bits))
+    lines += [cols] if jobs else []
     for s in jobs:
         mom = s.get("momentum") or {}
         last = s.get("last_event") or {}
@@ -1649,6 +1666,7 @@ def cmd_fleet(args) -> int:
                 rounds=args.rounds or None,
                 jobs=args.jobs or None,
                 keep=args.keep,
+                workers=getattr(args, "workers", 1),
             )
             if not res["ok"]:
                 failures.append(res)
@@ -1672,7 +1690,8 @@ def cmd_fleet(args) -> int:
             spec = {k: getattr(args, k) for k in SPEC_FIELDS}
             out = client.submit(
                 addr, spec, priority=args.priority,
-                deadline_s=args.deadline, retries=retries,
+                deadline_s=args.deadline,
+                tenant=getattr(args, "tenant", None), retries=retries,
             )
             # stdout is exactly the job id — script-composable
             # (`JOB=$(python -m madsim_tpu fleet submit ...)`)
@@ -2699,6 +2718,13 @@ def main(argv=None) -> int:
     q.add_argument("--deadline", type=float, default=None,
                    help="relative deadline in wall seconds; the worker "
                    "stops the job when it passes")
+    q.add_argument(
+        "--tenant", default=None,
+        help="admission-accounting identity: the server's per-tenant "
+        "token bucket ($MADSIM_TPU_FLEET_RATE_LIMIT) charges this name; "
+        "a 429 refusal names it and the client retries after the "
+        "server's Retry-After",
+    )
     q.set_defaults(fn=cmd_fleet)
 
     for verb, hlp in (
@@ -2827,9 +2853,19 @@ def main(argv=None) -> int:
                    help="chaos schedule seed (the repro key)")
     q.add_argument("--sweep", type=int, default=1,
                    help="run N consecutive seeds starting at --seed")
-    q.add_argument("--profile", choices=("kill", "torn", "mixed"),
+    q.add_argument("--profile",
+                   choices=("kill", "torn", "mixed", "spans", "claims"),
                    default="mixed",
-                   help="fault-mix weighting of the schedule")
+                   help="fault-mix weighting of the schedule ('claims' "
+                   "weights the contention plane: claim races, zombie "
+                   "resumes, single-victim lease jumps, torn queue.log "
+                   "appends)")
+    q.add_argument("--workers", type=int, default=1,
+                   help="race N workers against the one store every "
+                   "worker round (adds the contention invariants: no "
+                   "(job, batch, gen) executed twice, no find filed "
+                   "twice, reports still byte-identical to the "
+                   "1-worker oracle)")
     q.add_argument("--rounds", type=int, default=0,
                    help="override the schedule's round count (0 = from "
                    "the seed)")

@@ -3,8 +3,21 @@
 Extends the `serve --service stats` pattern (plain `http.server`,
 read-only files, no sim/jax imports) to a read/write job API::
 
-    POST   /jobs             submit {"spec": {...}, "priority", "deadline_s"}
-                             (a bare spec object also works)
+    POST   /jobs             submit {"spec": {...}, "priority", "deadline_s",
+                             "tenant"} (a bare spec object also works).
+                             Admission-controlled: per-tenant token-bucket
+                             rate limits ($MADSIM_TPU_FLEET_RATE_LIMIT /
+                             _RATE_BURST), a queue-depth cap
+                             ($MADSIM_TPU_FLEET_MAX_QUEUE_DEPTH) and a
+                             load-shed threshold
+                             ($MADSIM_TPU_FLEET_SHED_DEPTH) answer 429
+                             with a `Retry-After` header and a
+                             `retry_after_s` body field instead of
+                             accepting work the farm can't absorb — the
+                             write queue forms in the clients' seeded-
+                             jitter retry loops, so every 201 the server
+                             ever sent stays durable (zero accepted-job
+                             loss).
     GET    /jobs             = /queue
     GET    /queue            state counts + per-job summaries
     GET    /jobs/{id}        full job doc + live feed (?feed=N batch rows
@@ -47,7 +60,16 @@ read-only files, no sim/jax imports) to a read/write job API::
     GET    /healthz          liveness + store integrity (read-only fsck
                              scan: corrupt files, queue depth, stale
                              leases, quarantined jobs; 503 when the
-                             store needs `fleet fsck`)
+                             store needs `fleet fsck` — and while the
+                             farm is load-shedding writes, so a probe
+                             sees the degradation). Also surfaces the
+                             contention plane: per-worker claim-conflict
+                             and fenced-write counts, queue-log lag, and
+                             the shed state.
+
+    While load-shedding, GET /jobs and /queue serve a degraded summary
+    straight from the queue index (no per-job doc reads, no momentum) —
+    reads stay cheap exactly when the farm is drowning.
 
 Everything the API serves is an atomic-rename artifact (job docs,
 StatsEmitter snapshots), so no response can observe a torn write — and
@@ -63,6 +85,7 @@ from __future__ import annotations
 import http.server
 import json
 import logging
+import math
 import os
 import re
 import threading
@@ -185,12 +208,89 @@ def _parse_prom(path: str) -> List[tuple]:
     return rows
 
 
+class _TokenBucket:
+    """One tenant's admission budget: `rate` tokens/s refill up to
+    `burst`. `take()` spends one token or returns how long until one
+    exists — that number IS the Retry-After the client is told."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.ts = time.monotonic()  # madsim: allow(D001)
+
+    def take(self) -> float:
+        now = time.monotonic()  # madsim: allow(D001)
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.ts) * self.rate)
+        self.ts = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return 0.0
+        return (1.0 - self.tokens) / self.rate
+
+
 class FleetAPI:
+    #: Retry-After answered while shedding or depth-capped — depth
+    #: recovers at drain speed, not token-refill speed, so the hint is
+    #: a flat "come back soon" rather than a bucket computation
+    SHED_RETRY_S = 1.0
+
     def __init__(self, store: JobStore):
         self.store = store
         self._prom_cache = _FileCache()
         self._events_cache = _FileCache()
         self._bench_cache = _FileCache()
+        # -- admission control (all knobs default OFF: unset/0 keeps
+        # the pre-admission behavior byte-for-byte) -----------------------
+        env = os.environ.get
+        self.rate_limit = float(env("MADSIM_TPU_FLEET_RATE_LIMIT") or 0)
+        self.rate_burst = (float(env("MADSIM_TPU_FLEET_RATE_BURST") or 0)
+                           or max(self.rate_limit, 1.0))
+        self.max_queue_depth = int(
+            env("MADSIM_TPU_FLEET_MAX_QUEUE_DEPTH") or 0)
+        self.shed_depth = int(env("MADSIM_TPU_FLEET_SHED_DEPTH") or 0)
+        self._admission_lock = threading.Lock()
+        self._buckets: Dict[str, _TokenBucket] = {}
+        #: tenant -> {admitted, rate_limited, depth_limited, shed}
+        self._admission: Dict[str, Dict[str, int]] = {}
+        self.shedding = False
+        self.sheds_total = 0
+
+    # -- admission -----------------------------------------------------------
+
+    def _queue_depth(self) -> int:
+        """Backlog from the queue index, not the docs: admission stays
+        O(1) per request even at a 10k-job store."""
+        return sum(1 for row in self.store.queue_rows().values()
+                   if row.get("state") not in TERMINAL)
+
+    def _update_shed(self, depth: int) -> bool:
+        """Enter shed at depth >= $MADSIM_TPU_FLEET_SHED_DEPTH, leave
+        as soon as the backlog drains below it. 0/unset never sheds."""
+        with self._admission_lock:
+            want = bool(self.shed_depth) and depth >= self.shed_depth
+            if want and not self.shedding:
+                self.sheds_total += 1
+            self.shedding = want
+            return want
+
+    def _count_admission(self, tenant: str, outcome: str) -> None:
+        with self._admission_lock:
+            per = self._admission.setdefault(tenant, {})
+            per[outcome] = per.get(outcome, 0) + 1
+
+    def _reject(self, tenant: str, reason: str, retry_after_s: float,
+                depth: int) -> Tuple[int, str, bytes]:
+        self._count_admission(tenant, reason)
+        return _json(429, {
+            "error": f"admission refused ({reason}); retry after "
+                     f"{retry_after_s:g}s",
+            "reason": reason,
+            "tenant": tenant,
+            "queue_depth": depth,
+            "retry_after_s": round(retry_after_s, 3),
+        })
 
     def _job_events(self, job_id: str) -> List[dict]:
         """The job's event log via the stat-keyed cache (scrapes and
@@ -254,11 +354,32 @@ class FleetAPI:
             return _err(400, f"body is not JSON: {exc}")
         if not isinstance(doc, dict):
             return _err(400, "body must be a JSON object")
+        tenant = str(doc.get("tenant") or "default")
         spec = doc.get("spec", None)
         if spec is None:
             # bare-spec convenience: {"machine": ...} without the wrapper
             spec = {k: v for k, v in doc.items()
-                    if k not in ("priority", "deadline_s")}
+                    if k not in ("priority", "deadline_s", "tenant")}
+        # admission, cheapest check first, all reads from the index:
+        # shed beats depth beats rate (a shedding farm refuses even
+        # tenants with tokens to spend)
+        depth = self._queue_depth()
+        if self._update_shed(depth):
+            return self._reject(tenant, "shed", self.SHED_RETRY_S, depth)
+        if self.max_queue_depth and depth >= self.max_queue_depth:
+            return self._reject(tenant, "depth_limited",
+                                self.SHED_RETRY_S, depth)
+        if self.rate_limit:
+            with self._admission_lock:
+                bucket = self._buckets.get(tenant)
+                if bucket is None:
+                    bucket = self._buckets[tenant] = _TokenBucket(
+                        self.rate_limit, self.rate_burst)
+                wait = bucket.take()
+            if wait > 0:
+                return self._reject(tenant, "rate_limited",
+                                    max(wait, 0.001), depth)
+        self._count_admission(tenant, "admitted")
         job = self.store.submit(
             spec,
             priority=int(doc.get("priority", 0) or 0),
@@ -267,7 +388,39 @@ class FleetAPI:
         return _json(201, {"id": job.id, "state": job.state,
                            "subkey": job.subkey})
 
+    def _farm(self, *, degraded: bool) -> dict:
+        """The contention plane for `fleet top` and /healthz: per-worker
+        claim-conflict / fenced-write counts (the workers mirror them to
+        workers/<id>.json), the queue-log lag, and the shed state. The
+        O(n) lag scan is skipped while degraded — that's the whole
+        point of shedding."""
+        farm: dict = {
+            "shed": self.shedding,
+            "workers": self.store.read_worker_stats(),
+        }
+        if not degraded:
+            farm["queue_log_lag"] = self.store.queue_log_lag()
+        return farm
+
     def _queue(self) -> Tuple[int, str, bytes]:
+        if self._update_shed(self._queue_depth()):
+            # degraded read: the queue index IS the response — one log
+            # read, zero per-job doc/event/momentum I/O
+            rows = self.store.queue_rows()
+            counts: Dict[str, int] = {}
+            for row in rows.values():
+                s = row.get("state") or "?"
+                counts[s] = counts.get(s, 0) + 1
+            return _json(200, {
+                "degraded": True,
+                "counts": counts,
+                "jobs": [
+                    {"id": jid, "state": row.get("state"),
+                     "worker": row.get("worker")}
+                    for jid, row in sorted(rows.items())
+                ],
+                "farm": self._farm(degraded=True),
+            })
         from .scheduler import job_momentum
 
         jobs = self.store.list()
@@ -284,6 +437,7 @@ class FleetAPI:
         return _json(200, {
             "counts": {s: n for s, n in self.store.counts().items() if n},
             "jobs": summaries,
+            "farm": self._farm(degraded=False),
         })
 
     #: ?wait=S ceiling — a long-poll never parks a server thread
@@ -484,7 +638,11 @@ class FleetAPI:
         from . import fsck
 
         rep = fsck.scan(self.store)
-        ok = rep["corrupt"] == 0
+        shedding = self._update_shed(self._queue_depth())
+        store_ok = rep["corrupt"] == 0
+        # a shedding farm is alive but degraded: writes are being
+        # refused, so the probe answers 503 until the backlog drains
+        ok = store_ok and not shedding
         doc = {
             "ok": ok,
             "store": {
@@ -493,12 +651,19 @@ class FleetAPI:
                 "drifted_jobs": rep["drifted"],
                 "stale_tmp": rep["stale_tmp"],
                 "torn_tails": rep["torn_tails"],
+                "stale_claims": rep.get("stale_claims", 0),
             },
             "queue_depth": rep["queue_depth"],
             "stale_leases": rep["stale_leases"],
             "quarantined_jobs": rep["quarantined"],
-            **({} if ok else {"fix": "run `fleet fsck --root "
-                              f"{self.store.root}`"}),
+            "queue_log_lag": rep.get("queue_log_lag", 0),
+            "shed": shedding,
+            "workers": self.store.read_worker_stats(),
+            **({} if store_ok else {"fix": "run `fleet fsck --root "
+                                    f"{self.store.root}`"}),
+            **({"degraded": "load-shedding writes; queue depth "
+                f"{rep['queue_depth']} >= {self.shed_depth}"}
+               if shedding else {}),
         }
         return _json(200 if ok else 503, doc)
 
@@ -532,12 +697,50 @@ class FleetAPI:
             f"madsim_tpu_fleet_quarantined_jobs "
             f"{counts.get('quarantined', 0)}"
         )
+        # the contention plane: claim races lost (per-worker stats
+        # docs), zombie writes refused by fencing (per-job docs), the
+        # index's honesty, and the admission ledger
+        wstats = self.store.read_worker_stats()
+        lines.append("# TYPE madsim_tpu_fleet_claim_conflicts_total counter")
+        lines.append(
+            f"madsim_tpu_fleet_claim_conflicts_total "
+            f"{sum(int(w.get('claim_conflicts', 0)) for w in wstats.values())}"
+        )
+        lines.append("# TYPE madsim_tpu_fleet_fenced_writes_total counter")
+        lines.append(
+            f"madsim_tpu_fleet_fenced_writes_total "
+            f"{sum(j.n_fenced_writes for j in jobs)}"
+        )
+        lines.append("# TYPE madsim_tpu_fleet_queue_log_lag gauge")
+        lines.append(
+            f"madsim_tpu_fleet_queue_log_lag {self.store.queue_log_lag()}")
+        lines.append("# TYPE madsim_tpu_fleet_shed gauge")
+        lines.append(f"madsim_tpu_fleet_shed {int(self.shedding)}")
+        lines.append("# TYPE madsim_tpu_fleet_sheds_total counter")
+        lines.append(f"madsim_tpu_fleet_sheds_total {self.sheds_total}")
+        with self._admission_lock:
+            admission = {t: dict(per) for t, per in self._admission.items()}
+        if admission:
+            lines.append("# TYPE madsim_tpu_fleet_admission_total counter")
+            for tenant in sorted(admission):
+                for outcome in sorted(admission[tenant]):
+                    lines.append(
+                        f'madsim_tpu_fleet_admission_total'
+                        f'{{tenant="{tenant}",outcome="{outcome}"}} '
+                        f'{admission[tenant][outcome]}'
+                    )
         self._slo_histograms(lines, jobs)
         self._bench_trajectory(lines)
         seen_types = {"madsim_tpu_fleet_jobs",
                       "madsim_tpu_fleet_requeues_total",
                       "madsim_tpu_fleet_lease_reclaims_total",
-                      "madsim_tpu_fleet_quarantined_jobs"}
+                      "madsim_tpu_fleet_quarantined_jobs",
+                      "madsim_tpu_fleet_claim_conflicts_total",
+                      "madsim_tpu_fleet_fenced_writes_total",
+                      "madsim_tpu_fleet_queue_log_lag",
+                      "madsim_tpu_fleet_shed",
+                      "madsim_tpu_fleet_sheds_total",
+                      "madsim_tpu_fleet_admission_total"}
         for job in jobs:
             # parsed-textfile cache keyed (path, mtime, size): a scrape
             # of an unchanged store re-parses nothing, so scrape cost
@@ -659,6 +862,17 @@ def make_handler(api: FleetAPI):
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
+            if status == 429:
+                # the admission verdict carries the precise wait in its
+                # JSON body (retry_after_s); the header is the RFC's
+                # integer delta-seconds rendering of the same number
+                try:
+                    ra = json.loads(payload).get("retry_after_s")
+                except (json.JSONDecodeError, ValueError, AttributeError):
+                    ra = None
+                if ra is not None:
+                    self.send_header("Retry-After",
+                                     str(max(1, math.ceil(float(ra)))))
             self.end_headers()
             self.wfile.write(payload)
 

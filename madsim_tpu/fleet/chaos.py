@@ -64,6 +64,36 @@ The fault vocabulary (`derive_schedule`):
                   tail without quarantining the log (it is an append-
                   mode observability stream, not sim state)
 
+The ``claims`` profile (PR 20) races the contention plane itself and
+only makes sense with ``--workers N`` > 1 (it still passes at 1 —
+the races just never fire):
+
+``claim_race``    SIGKILL one contender at its k-th O_EXCL claim-file
+                  create — the other racers must arbitrate around the
+                  corpse's stale claim (flock stays authoritative)
+``zombie_resume`` SIGSTOP one worker at its k-th CHECKPOINT write (a
+                  path written outside every store flock), expire its
+                  lease, let a new holder reclaim and finish the job,
+                  then SIGCONT the zombie — every resumed write must
+                  be REFUSED by its dead fencing generation, counted
+                  on the doc, never merged
+``lease_jump_one``  jump the lease clock for ONE worker's holdings
+                  only (the suspended-VM case): its jobs reclaim while
+                  every other lease stays live
+``torn_queue_log``  the kill lands mid-append to the shared queue.log:
+                  a torn tail reaches the REAL file — index readers
+                  must leave it unconsumed, pollers fall back to the
+                  docs, and fsck rebuilds the log from them
+
+With ``--workers N`` every worker-running round launches N synthetic
+workers CONCURRENTLY against one store (ids ``chaos-w0..``, the armed
+chaos plan on a seeded choice of one), and two contention invariants
+join the originals: **no (job, batch, generation) is executed by two
+workers** (batch_done events are the witness) and **no find is filed
+twice** (corpus keys stay unique). The final reports must STILL be
+byte-identical to the 1-worker oracle — contention is not allowed to
+change a single result byte.
+
 By default workers run the jax-free **synthetic driver** below — the
 deterministic stand-in for `_stream_batches` that drives the REAL
 checkpoint, stats-emitter and store machinery (the farm paths under
@@ -124,6 +154,13 @@ _PROFILES = {
     # the profiles above keep their schedules byte-identical
     "spans": (("sigterm_worker", 5), ("kill_worker", 1),
               ("lease_jump", 1), ("clean_units", 2)),
+    # PR 20: the contention profile — claim races, zombie resumes,
+    # single-worker lease jumps and torn queue-log tails. A NEW
+    # profile (same precedent as "spans") so kill/torn/mixed pinned
+    # seeds keep their schedules byte-identical
+    "claims": (("claim_race", 4), ("zombie_resume", 2),
+               ("lease_jump_one", 2), ("torn_queue_log", 2),
+               ("kill_worker", 1), ("clean_units", 2)),
 }
 
 
@@ -276,6 +313,19 @@ def derive_schedule(seed: int, *, profile: str = "mixed",
         elif action == "torn_events":
             ev["job_index"] = rng.randrange(n_jobs)
             ev["cut"] = rng.randint(2, 25)
+        elif action == "claim_race":
+            # the k-th O_EXCL claim create (the .claim match counts
+            # nothing else) — k small: claims happen once per lease
+            ev["at_claim"] = rng.randint(1, 3)
+        elif action == "zombie_resume":
+            # counts CHECKPOINT writes only: .ckpt saves happen outside
+            # every store flock, so a stopped zombie wedges nobody
+            ev["at_write"] = rng.randint(1, 4)
+        elif action == "torn_queue_log":
+            ev["at_write"] = rng.randint(1, 6)
+            ev["at_byte"] = rng.randint(0, 80)
+        # lease_jump_one carries no params: the victim worker is
+        # whoever holds a live lease when the round fires
         events.append(ev)
     return {"seed": seed, "profile": profile, "real": real,
             "specs": specs, "events": events}
@@ -300,16 +350,11 @@ def _start_server(root: str, port_file: str,
     return proc
 
 
-def _run_worker(root: str, *, chaos: Optional[dict] = None,
-                max_units: int = 0,
-                real: bool = False, backoff_base_s: float = 0.05,
-                lease_ttl_s: float = 30.0,
-                timeout_s: float = 120.0) -> subprocess.CompletedProcess:
-    """One worker incarnation. An armed chaos plan makes it SIGKILL
-    itself at the scheduled write (rc -9); otherwise it exits 0 after
-    draining / its unit budget."""
+def _worker_cmd(root: str, *, worker_id: str, max_units: int,
+                real: bool, backoff_base_s: float,
+                lease_ttl_s: float) -> tuple:
     cmd = [sys.executable, "-m", "madsim_tpu", "fleet", "worker",
-           "--root", root, "--worker-id", "chaos-w", "--poll", "0.02",
+           "--root", root, "--worker-id", worker_id, "--poll", "0.02",
            "--lease-ttl", str(lease_ttl_s),
            "--backoff-base", str(backoff_base_s),
            # always drain-capable: a unit-budgeted round on an already-
@@ -319,28 +364,115 @@ def _run_worker(root: str, *, chaos: Optional[dict] = None,
         cmd += ["--driver", "synthetic"]
     if max_units:
         cmd += ["--max-units", str(max_units)]
+    return tuple(cmd)
+
+
+def _worker_env(chaos: Optional[dict]) -> dict:
     env = dict(os.environ)
     env.pop(CHAOS_ENV, None)
     if chaos is not None:
         env[CHAOS_ENV] = json.dumps(chaos)
+    return env
+
+
+def _run_worker(root: str, *, chaos: Optional[dict] = None,
+                max_units: int = 0, worker_id: str = "chaos-w",
+                real: bool = False, backoff_base_s: float = 0.05,
+                lease_ttl_s: float = 30.0,
+                timeout_s: float = 120.0) -> subprocess.CompletedProcess:
+    """One worker incarnation. An armed chaos plan makes it SIGKILL
+    itself at the scheduled write (rc -9); otherwise it exits 0 after
+    draining / its unit budget."""
     return subprocess.run(
-        cmd, env=env, timeout=timeout_s,
+        _worker_cmd(root, worker_id=worker_id, max_units=max_units,
+                    real=real, backoff_base_s=backoff_base_s,
+                    lease_ttl_s=lease_ttl_s),
+        env=_worker_env(chaos), timeout=timeout_s,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
 
 
-def _expire_leases(root: str) -> int:
-    """The lease-clock jump: rewrite every live lease as already
-    expired (what a suspended worker VM looks like to the farm)."""
+def _spawn_worker(root: str, *, worker_id: str,
+                  chaos: Optional[dict] = None, max_units: int = 0,
+                  real: bool = False, backoff_base_s: float = 0.05,
+                  lease_ttl_s: float = 30.0) -> subprocess.Popen:
+    """Popen variant of `_run_worker` for rounds that run several
+    workers at once (or need to signal one mid-flight)."""
+    return subprocess.Popen(
+        _worker_cmd(root, worker_id=worker_id, max_units=max_units,
+                    real=real, backoff_base_s=backoff_base_s,
+                    lease_ttl_s=lease_ttl_s),
+        env=_worker_env(chaos),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _race_workers(root: str, worker_ids, *, plans: Optional[dict] = None,
+                  max_units: int = 0, real: bool = False,
+                  backoff_base_s: float = 0.05,
+                  lease_ttl_s: float = 30.0,
+                  timeout_s: float = 120.0) -> dict:
+    """Launch every worker in `worker_ids` CONCURRENTLY against one
+    store — the genuine N-claimants race the tentpole is about.
+    `plans` optionally arms a chaos plan on specific worker ids.
+    Returns {worker_id: returncode} (a worker that outlives the
+    timeout is killed and reported as -9)."""
+    procs = {
+        wid: _spawn_worker(root, worker_id=wid,
+                           chaos=(plans or {}).get(wid),
+                           max_units=max_units, real=real,
+                           backoff_base_s=backoff_base_s,
+                           lease_ttl_s=lease_ttl_s)
+        for wid in worker_ids
+    }
+    deadline = time.monotonic() + timeout_s
+    rcs = {}
+    for wid, p in procs.items():
+        try:
+            p.wait(timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+        rcs[wid] = p.returncode
+    return rcs
+
+
+def _wait_stopped(pid: int, timeout_s: float = 30.0) -> bool:
+    """Poll /proc until the process is SIGSTOPped (state T) or gone.
+    True = it is stopped and safe to operate around; False = it exited
+    first (the write budget outlived the unit — nothing to zombify)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                state = f.read().rsplit(")", 1)[1].split()[0]
+        except (OSError, IndexError):
+            return False
+        if state in ("T", "t"):
+            return True
+        if state == "Z":
+            return False
+        time.sleep(0.02)
+    return False
+
+
+def _expire_leases(root: str, worker: Optional[str] = None) -> int:
+    """The lease-clock jump: rewrite live leases as already expired
+    (what a suspended worker VM looks like to the farm). With
+    `worker`, only THAT worker's holdings jump — the single-victim
+    variant the claims profile uses."""
     store = JobStore(root)
 
     def mut(j) -> None:
-        if j.lease is not None:
+        if j.lease is not None and (
+                worker is None or j.lease.get("worker") == worker):
             j.lease["expires_ts"] = 0.0
 
     n = 0
     for job in store.list():
         if job.lease is None:
+            continue
+        if worker is not None and job.lease.get("worker") != worker:
             continue
         store._update(job.id, mut)
         n += 1
@@ -412,6 +544,51 @@ def _truncate_file(path: str, at_byte: int) -> bool:
     return True
 
 
+def _contention_violations(root: str) -> List[str]:
+    """The two multi-worker invariants, read back from the artifacts.
+
+    * **no (job, batch, generation) executed by two unfenced workers**
+      — every accepted `batch_done` event names its worker and the
+      lease generation that authorized it; two workers landing the
+      same (batch, gen) means a zombie write was merged instead of
+      fenced. (The same batch under DIFFERENT generations is the
+      legitimate requeue-and-retry path.)
+    * **no find filed twice** — corpus entry keys
+      (machine, nodes, seed, fail_code) stay unique even when racing
+      workers both reach the filing path (parsed straight from
+      corpus.json; chaos stays jax-free by contract).
+    """
+    out: List[str] = []
+    store = JobStore(root)
+    for job in store.list():
+        owners: dict = {}
+        for ev in store.read_events(job.id):
+            if ev.get("type") != "batch_done":
+                continue
+            key = (ev.get("batch"), ev.get("gen"))
+            w = ev.get("worker")
+            prev = owners.setdefault(key, w)
+            if prev != w:
+                out.append(
+                    f"job {job.id} batch {key[0]} gen {key[1]} executed "
+                    f"by two unfenced workers: {prev} and {w}"
+                )
+    try:
+        with open(store.corpus_path) as f:
+            entries = json.load(f).get("entries", [])
+    except (OSError, json.JSONDecodeError, AttributeError):
+        entries = []
+    seen: dict = {}
+    for e in entries:
+        key = (e.get("machine"), e.get("nodes"), e.get("seed"),
+               e.get("fail_code"))
+        seen[key] = seen.get(key, 0) + 1
+    for key, n in seen.items():
+        if n > 1:
+            out.append(f"find filed {n} times: corpus key {key}")
+    return out
+
+
 # -- the orchestrator --------------------------------------------------------
 
 
@@ -419,10 +596,15 @@ def run_chaos(seed: int, *, profile: str = "mixed",
               out_dir: Optional[str] = None, real: bool = False,
               rounds: Optional[int] = None, jobs: Optional[int] = None,
               keep: bool = False, backoff_base_s: float = 0.05,
-              recovery_rounds: int = 8) -> dict:
+              recovery_rounds: int = 8, workers: int = 1) -> dict:
     """Run one seeded chaos schedule against a scratch farm and check
     every invariant. Returns the result dict ({"ok", "violations",
-    ...}); prints the exact reproduction line on failure."""
+    ...}); prints the exact reproduction line on failure.
+
+    `workers` > 1 turns every worker-running round into an N-way race
+    against one store (the armed chaos plan rides a seeded choice of
+    contender), adds the contention invariants, and still demands the
+    final reports byte-identical to the 1-worker oracle."""
     sched = derive_schedule(seed, profile=profile, rounds=rounds,
                             jobs=jobs, real=real)
     ephemeral = out_dir is None
@@ -444,6 +626,34 @@ def run_chaos(seed: int, *, profile: str = "mixed",
     def _note(msg: str) -> None:
         print(f"chaos[{seed}]: {msg}", flush=True)
 
+    n_workers = max(1, int(workers))
+    wids = (["chaos-w"] if n_workers == 1
+            else [f"chaos-w{i}" for i in range(n_workers)])
+    # which contender carries the armed plan is itself seeded —
+    # a failing (seed, workers) pair replays the same victim forever
+    race_rng = random.Random(f"fleet-chaos-race {seed} {n_workers}")
+
+    def _worker_round(*, chaos: Optional[dict] = None,
+                      max_units: int = 0) -> dict:
+        """One worker-running round: a single incarnation at
+        --workers 1 (byte-identical to the pre-race harness), a
+        genuine N-way race otherwise. Returns {worker_id: rc}."""
+        if n_workers == 1:
+            p = _run_worker(root, chaos=chaos, max_units=max_units,
+                            worker_id=wids[0], real=real,
+                            backoff_base_s=backoff_base_s,
+                            timeout_s=worker_timeout)
+            return {wids[0]: p.returncode}
+        plans = ({race_rng.choice(wids): chaos}
+                 if chaos is not None else None)
+        return _race_workers(root, wids, plans=plans,
+                             max_units=max_units, real=real,
+                             backoff_base_s=backoff_base_s,
+                             timeout_s=worker_timeout)
+
+    def _rcs_str(rcs: dict) -> str:
+        return ",".join(str(rc) for rc in rcs.values())
+
     server = _start_server(root, port_file)
     try:
         addr = fleet_client.resolve_addr(None, port_file, wait_s=30.0)
@@ -456,24 +666,16 @@ def run_chaos(seed: int, *, profile: str = "mixed",
         for ev in sched["events"]:
             action = ev["action"]
             if action == "kill_worker":
-                p = _run_worker(
-                    root, chaos={"kill_at_write": ev["at_write"],
-                                 "match": root},
-                    real=real,
-                    backoff_base_s=backoff_base_s,
-                    timeout_s=worker_timeout,
-                )
+                rcs = _worker_round(
+                    chaos={"kill_at_write": ev["at_write"],
+                           "match": root})
                 _note(f"round {ev['round']}: kill_worker at write "
-                      f"{ev['at_write']} -> rc {p.returncode}")
+                      f"{ev['at_write']} -> rc {_rcs_str(rcs)}")
             elif action == "sigterm_worker":
-                p = _run_worker(
-                    root, chaos={"sigterm_at_write": ev["at_write"],
-                                 "match": ".ckpt"},
-                    real=real,
-                    backoff_base_s=backoff_base_s,
-                    timeout_s=worker_timeout,
-                )
-                died = p.returncode == -signal.SIGTERM
+                rcs = _worker_round(
+                    chaos={"sigterm_at_write": ev["at_write"],
+                           "match": ".ckpt"})
+                died = -signal.SIGTERM in rcs.values()
                 flushed = _partial_span_dumped(root)
                 # the satellite invariant: a gracefully killed worker
                 # leaves its open spans behind, tagged partial (if the
@@ -485,21 +687,16 @@ def run_chaos(seed: int, *, profile: str = "mixed",
                         f"no partial span dump"
                     )
                 _note(f"round {ev['round']}: sigterm_worker at write "
-                      f"{ev['at_write']} -> rc {p.returncode} "
+                      f"{ev['at_write']} -> rc {_rcs_str(rcs)} "
                       f"(partial spans {'flushed' if flushed else 'absent'})")
             elif action == "torn_write":
-                p = _run_worker(
-                    root,
+                rcs = _worker_round(
                     chaos={"torn_at_write": [ev["at_write"],
                                              ev["at_byte"]],
-                           "match": root},
-                    real=real,
-                    backoff_base_s=backoff_base_s,
-                    timeout_s=worker_timeout,
-                )
+                           "match": root})
                 _note(f"round {ev['round']}: torn_write "
                       f"[{ev['at_write']}, {ev['at_byte']}] -> "
-                      f"rc {p.returncode}")
+                      f"rc {_rcs_str(rcs)}")
             elif action == "corrupt_ckpt":
                 if ev["job_index"] < len(job_ids):
                     jid = job_ids[ev["job_index"]]
@@ -552,29 +749,95 @@ def run_chaos(seed: int, *, profile: str = "mixed",
                       f"{ev['verb']} -> "
                       f"{box.get('out', {}).get('id', 'ok')}")
             elif action == "clean_units":
-                p = _run_worker(
-                    root, max_units=ev["units"], real=real,
-                    backoff_base_s=backoff_base_s,
-                    timeout_s=worker_timeout,
-                )
+                rcs = _worker_round(max_units=ev["units"])
                 _note(f"round {ev['round']}: clean_units "
-                      f"{ev['units']} -> rc {p.returncode}")
+                      f"{ev['units']} -> rc {_rcs_str(rcs)}")
             elif action == "kill_event_append":
                 # the SIGKILL lands mid-append to an events.jsonl: the
                 # match filter counts ONLY event-log appends, and the
                 # torn prefix reaches the real file before the kill
-                p = _run_worker(
-                    root,
+                rcs = _worker_round(
                     chaos={"torn_at_write": [ev["at_write"],
                                              ev["at_byte"]],
-                           "match": ".events.jsonl"},
-                    real=real,
-                    backoff_base_s=backoff_base_s,
-                    timeout_s=worker_timeout,
-                )
+                           "match": ".events.jsonl"})
                 _note(f"round {ev['round']}: kill_event_append "
                       f"[{ev['at_write']}, {ev['at_byte']}] -> "
-                      f"rc {p.returncode}")
+                      f"rc {_rcs_str(rcs)}")
+            elif action == "claim_race":
+                # one contender dies AT its k-th O_EXCL claim create;
+                # the survivors must arbitrate around the stale claim
+                rcs = _worker_round(
+                    chaos={"kill_at_write": ev["at_claim"],
+                           "match": ".claim"})
+                _note(f"round {ev['round']}: claim_race kill at claim "
+                      f"{ev['at_claim']} -> rc {_rcs_str(rcs)}")
+            elif action == "torn_queue_log":
+                # the kill lands mid-append to the SHARED queue.log:
+                # the torn tail reaches the real file, readers must
+                # leave it unconsumed, fsck rebuilds from the docs
+                rcs = _worker_round(
+                    chaos={"torn_at_write": [ev["at_write"],
+                                             ev["at_byte"]],
+                           "match": "queue.log"})
+                _note(f"round {ev['round']}: torn_queue_log "
+                      f"[{ev['at_write']}, {ev['at_byte']}] -> "
+                      f"rc {_rcs_str(rcs)}")
+            elif action == "lease_jump_one":
+                # the suspended-VM case, single victim: jump ONE
+                # worker's lease clock, leave every other lease live
+                holders = sorted({
+                    (j.lease or {}).get("worker")
+                    for j in JobStore(root).list()
+                    if j.lease is not None
+                } - {None})
+                victim = race_rng.choice(holders) if holders else None
+                if victim is None:
+                    _note(f"round {ev['round']}: lease_jump_one "
+                          f"(no live leases; skipped)")
+                else:
+                    n = _expire_leases(root, worker=victim)
+                    acts = fsck_mod.fsck(
+                        root, fix=True, reclaim=True,
+                        backoff_base_s=backoff_base_s,
+                    ).get("reclaimed", [])
+                    _note(f"round {ev['round']}: lease_jump_one "
+                          f"{victim} expired {n} lease(s), sweep "
+                          f"reclaimed {len(acts)}")
+            elif action == "zombie_resume":
+                # SIGSTOP a worker at a checkpoint write (outside every
+                # store flock), steal its jobs, then SIGCONT it — the
+                # zombie's resumed writes must die on the fence
+                zombie_id = race_rng.choice(wids)
+                rescue_ids = [w for w in wids if w != zombie_id] or [
+                    f"{zombie_id}-rescue"]
+                z = _spawn_worker(
+                    root, worker_id=zombie_id,
+                    chaos={"sigstop_at_write": ev["at_write"],
+                           "match": ".ckpt"},
+                    real=real, backoff_base_s=backoff_base_s)
+                stopped = _wait_stopped(z.pid, timeout_s=worker_timeout)
+                if stopped:
+                    n = _expire_leases(root, worker=zombie_id)
+                    fsck_mod.fsck(root, fix=True, reclaim=True,
+                                  backoff_base_s=backoff_base_s)
+                    rcs = _race_workers(
+                        root, rescue_ids, real=real,
+                        backoff_base_s=backoff_base_s,
+                        timeout_s=worker_timeout)
+                    os.kill(z.pid, signal.SIGCONT)
+                else:
+                    n, rcs = 0, {}
+                try:
+                    z.wait(timeout=worker_timeout)
+                except subprocess.TimeoutExpired:
+                    z.kill()
+                    z.wait()
+                what = (f"stopped, {n} lease(s) stolen, rescue rc "
+                        f"{_rcs_str(rcs)}" if stopped
+                        else "outlived its write budget")
+                _note(f"round {ev['round']}: zombie_resume {zombie_id} "
+                      f"at ckpt write {ev['at_write']} ({what}); "
+                      f"zombie rc {z.returncode}")
             elif action == "torn_events":
                 if ev["job_index"] < len(job_ids):
                     jid = job_ids[ev["job_index"]]
@@ -591,9 +854,7 @@ def run_chaos(seed: int, *, profile: str = "mixed",
             fsck_mod.fsck(root, fix=True, reclaim=True,
                           release_quarantined=True,
                           backoff_base_s=backoff_base_s)
-            p = _run_worker(root, real=real,
-                            backoff_base_s=backoff_base_s,
-                            timeout_s=worker_timeout)
+            _worker_round()
             jobs_now = {j.id: j for j in store.list()}
             missing = [jid for jid in job_ids if jid not in jobs_now]
             if not missing and all(
@@ -622,6 +883,9 @@ def run_chaos(seed: int, *, profile: str = "mixed",
             f"store not clean after fsck: {rescan['corrupt']} corrupt, "
             f"{rescan['stale_tmp']} stale tmp"
         )
+
+    # -- invariants: contention plane (gen-aware witnesses) -----------------
+    violations.extend(_contention_violations(root))
 
     # -- invariant: no accepted job lost ------------------------------------
     store = JobStore(root)
@@ -684,6 +948,7 @@ def run_chaos(seed: int, *, profile: str = "mixed",
         "ok": not violations,
         "seed": seed,
         "profile": profile,
+        "workers": n_workers,
         "violations": violations,
         "jobs": job_ids,
         "workdir": workdir,
@@ -699,6 +964,7 @@ def run_chaos(seed: int, *, profile: str = "mixed",
             + (" --real" if real else "")
             + (f" --rounds {rounds}" if rounds else "")
             + (f" --jobs {jobs}" if jobs else "")
+            + (f" --workers {n_workers}" if n_workers > 1 else "")
         )
         print(
             f"FLEET CHAOS FAILURE (seed {seed}): "

@@ -27,7 +27,10 @@ DEFAULT_ADDR = "127.0.0.1:8142"
 #: HTTP statuses worth retrying: the server is restarting or shedding
 #: load, not rejecting the request. Every other status (400 validation,
 #: 404, 409 not-terminal-yet) fails immediately — retrying a refusal
-#: only hides it.
+#: only hides it. 429 is retried too, but on the server's own schedule:
+#: the admission layer names its price (Retry-After header +
+#: `retry_after_s` body field) and the client honors it instead of
+#: guessing with exponential backoff.
 TRANSIENT_HTTP = frozenset({502, 503, 504})
 DEFAULT_RETRIES = 5
 RETRY_BACKOFF_S = 0.1
@@ -35,9 +38,14 @@ RETRY_BACKOFF_MAX_S = 2.0
 
 
 class FleetClientError(RuntimeError):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 retry_after: Optional[float] = None):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
+        #: the server's Retry-After in seconds (429 admission refusals;
+        #: the JSON body's sub-second `retry_after_s` wins over the
+        #: header's integer rendering), None when the server named none
+        self.retry_after = retry_after
 
 
 def resolve_addr(addr: Optional[str] = None,
@@ -76,11 +84,20 @@ def _request_once(addr: str, method: str, path: str,
             return resp.status, json.loads(resp.read().decode() or "{}")
     except urllib.error.HTTPError as exc:
         payload = exc.read().decode(errors="replace")
+        retry_after = None
         try:
-            msg = json.loads(payload).get("error", payload)
-        except json.JSONDecodeError:
+            doc = json.loads(payload)
+            msg = doc.get("error", payload)
+            if doc.get("retry_after_s") is not None:
+                retry_after = float(doc["retry_after_s"])
+        except (json.JSONDecodeError, TypeError, ValueError):
             msg = payload
-        raise FleetClientError(exc.code, msg) from None
+        if retry_after is None:
+            try:
+                retry_after = float(exc.headers.get("Retry-After"))
+            except (TypeError, ValueError):
+                retry_after = None
+        raise FleetClientError(exc.code, msg, retry_after) from None
 
 
 def request(addr: str, method: str, path: str,
@@ -108,8 +125,17 @@ def request(addr: str, method: str, path: str,
         try:
             return _request_once(addr, method, path, body, timeout)
         except FleetClientError as exc:
-            if exc.status not in TRANSIENT_HTTP or attempt >= retries:
+            retryable = exc.status in TRANSIENT_HTTP or exc.status == 429
+            if not retryable or attempt >= retries:
                 raise
+            if exc.status == 429 and exc.retry_after is not None:
+                # admission refusal: wait what the server asked, plus
+                # seeded jitter so a shed burst doesn't re-arrive as
+                # one synchronized herd
+                time.sleep(exc.retry_after  # madsim: allow(D001)
+                           + RETRY_BACKOFF_S * rng.random())
+                attempt += 1
+                continue
         except (urllib.error.URLError, ConnectionError, TimeoutError,
                 OSError):
             # URLError wraps ECONNREFUSED during a server restart
@@ -122,10 +148,13 @@ def request(addr: str, method: str, path: str,
 
 def submit(addr: str, spec: dict, *, priority: int = 0,
            deadline_s: Optional[float] = None,
+           tenant: Optional[str] = None,
            retries: int = DEFAULT_RETRIES) -> dict:
     doc = {"spec": spec, "priority": priority}
     if deadline_s:
         doc["deadline_s"] = deadline_s
+    if tenant:
+        doc["tenant"] = tenant  # admission accounting, not spec
     _, out = request(addr, "POST", "/jobs", doc, retries=retries)
     return out
 

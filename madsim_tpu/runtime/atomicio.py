@@ -34,6 +34,14 @@ scheduled one dies deterministically:
   process dies. The claim "atomic" makes is exactly that the final
   path still holds its previous version afterwards; `fleet fsck`
   sweeps the stale tmp.
+* ``{"sigstop_at_write": K}`` — SIGSTOP self at the K-th write
+  (once-only, like sigterm: the plan disarms itself first, so the
+  resumed process writes on normally). This is the zombie fixture:
+  the stopped worker's lease expires, a new holder takes the job,
+  and when the harness SIGCONTs the zombie its writes must be
+  fenced, never merged. The harness must only match paths written
+  OUTSIDE the store's file locks (e.g. ``.ckpt``) — a process
+  stopped while holding a flock would wedge every other worker.
 
 The plan is parsed once per process (the harness sets the env var
 before spawning the victim); `_reset_chaos_for_tests` re-arms it.
@@ -87,6 +95,9 @@ def _chaos_tick(path: str, text: str) -> None:
         # writer and must go through
         plan.pop("sigterm_at_write", None)
         os.kill(os.getpid(), signal.SIGTERM)
+    if plan.get("sigstop_at_write") == n:
+        plan.pop("sigstop_at_write", None)  # once-only; see module doc
+        os.kill(os.getpid(), signal.SIGSTOP)
     torn = plan.get("torn_at_write")
     if torn and int(torn[0]) == n:
         with open(f"{path}.tmp", "w") as f:
@@ -114,6 +125,9 @@ def _chaos_tick_append(path: str, text: str) -> None:
     if plan.get("sigterm_at_write") == n:
         plan.pop("sigterm_at_write", None)  # once-only; see _chaos_tick
         os.kill(os.getpid(), signal.SIGTERM)
+    if plan.get("sigstop_at_write") == n:
+        plan.pop("sigstop_at_write", None)  # once-only; see _chaos_tick
+        os.kill(os.getpid(), signal.SIGSTOP)
     torn = plan.get("torn_at_write")
     if torn and int(torn[0]) == n:
         with open(path, "a") as f:

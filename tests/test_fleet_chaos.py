@@ -702,6 +702,68 @@ def test_fleet_chaos_end_to_end_pinned_seed(tmp_path):
     assert any(f.endswith(".json") for f in farm_jobs)
 
 
+def test_chaos_claims_profile_schedule_derivation():
+    """The contention profile derives purely from the seed like every
+    other; its schedule never depends on --workers (the worker count
+    only picks which contender carries an armed plan, via a separate
+    seeded RNG); and it is a NEW profile, so the pinned seeds of the
+    pre-existing profiles keep their schedules byte-identical."""
+    a = derive_schedule(0, profile="claims")
+    assert a == derive_schedule(0, profile="claims")
+    assert a != derive_schedule(1, profile="claims")
+    new = {"claim_race", "zombie_resume", "lease_jump_one",
+           "torn_queue_log"}
+    assert {ev["action"] for ev in a["events"]} <= new | {
+        "kill_worker", "clean_units"}
+    seen = {ev["action"]
+            for s in range(16)
+            for ev in derive_schedule(s, profile="claims")["events"]}
+    assert new <= seen  # every contention action reachable
+    for s in range(16):
+        for ev in derive_schedule(s, profile="claims")["events"]:
+            if ev["action"] == "claim_race":
+                assert 1 <= ev["at_claim"] <= 3
+            elif ev["action"] == "zombie_resume":
+                assert 1 <= ev["at_write"] <= 4
+            elif ev["action"] == "torn_queue_log":
+                assert 1 <= ev["at_write"] <= 6
+                assert 0 <= ev["at_byte"] <= 80
+    # the pre-existing profiles never emit the contention actions
+    for profile in ("kill", "torn", "mixed", "spans"):
+        for seed in range(4):
+            sched = derive_schedule(seed, profile=profile)
+            assert not new & {ev["action"] for ev in sched["events"]}
+
+
+def test_fleet_chaos_two_workers_claims_pinned_seed(tmp_path):
+    """The tentpole e2e: TWO workers race one store through the claims
+    profile. Seed 3's schedule lands a genuine zombie round — a worker
+    SIGSTOPped at a checkpoint write, its leases stolen by the rescue
+    worker, then SIGCONT'd so its resumed writes die on the fence — and
+    the invariants must still hold: contention witnesses clean (no
+    (batch, gen) executed by two workers, no duplicate corpus keys),
+    no accepted job lost, reports byte-identical to the 1-WORKER
+    oracle. Jax-free (synthetic driver)."""
+    res = run_chaos(3, profile="claims", workers=2,
+                    out_dir=str(tmp_path / "out"))
+    assert res["ok"], res["violations"]
+    assert res["workers"] == 2
+    out = tmp_path / "out" / "seed3"
+    # the schedule is untouched by --workers: same derivation as 1-worker
+    assert json.load(open(out / "schedule.json")) == derive_schedule(
+        3, profile="claims")
+    assert json.load(open(out / "result.json"))["workers"] == 2
+    # the race was real: accepted batch work landed from BOTH contenders
+    st = JobStore(str(out / "farm"))
+    owners = {
+        ev.get("worker")
+        for job in st.list()
+        for ev in st.read_events(job.id)
+        if ev.get("type") == "batch_done"
+    }
+    assert len(owners) >= 2, f"no genuine race: batches only from {owners}"
+
+
 @pytest.mark.slow
 def test_fleet_chaos_real_engine(tmp_path):
     """The same medicine against REAL echo-machine engines: worker

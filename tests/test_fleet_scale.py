@@ -485,3 +485,198 @@ def test_fsck_removes_stale_claims_and_rebuilds_the_queue_log(tmp_path):
     st2 = JobStore(root)
     assert st2.queue_log_lag() == 0
     assert st2.queue_rows()[live.id]["worker"] == "w1"
+
+
+# -- admission control and graceful degradation (tentpole piece 3) -----------
+
+SYN_SPEC = {"machine": "chaos-echo", "seeds": 96, "batch": 32, "faults": 0,
+            "horizon": 1.0, "max_steps": 300}
+
+_ADMISSION_ENVS = (
+    "MADSIM_TPU_FLEET_RATE_LIMIT",
+    "MADSIM_TPU_FLEET_RATE_BURST",
+    "MADSIM_TPU_FLEET_MAX_QUEUE_DEPTH",
+    "MADSIM_TPU_FLEET_SHED_DEPTH",
+)
+
+
+def _admission_api(tmp_path, monkeypatch, **env):
+    """A FleetAPI over a fresh store with ONLY the given admission
+    knobs set (the envs are read once at construction)."""
+    from madsim_tpu.fleet.api import FleetAPI
+
+    for k in _ADMISSION_ENVS:
+        monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, str(v))
+    st = JobStore(str(tmp_path / "farm"))
+    return st, FleetAPI(st)
+
+
+def _drain(root):
+    from madsim_tpu.fleet.chaos import synthetic_driver
+
+    FleetWorker(root, worker_id="wDrain", poll_s=0.01,
+                driver=synthetic_driver).run(drain=True)
+
+
+def test_burst_past_rate_limit_429s_then_farm_drains(tmp_path, monkeypatch):
+    """The overload acceptance criterion: a synthetic burst past the
+    rate limit yields 429 + a retry hint, ZERO accepted-job loss, and
+    the farm drains to completion once the burst stops. Tenants spend
+    separate buckets; /metrics keeps the admission ledger."""
+    st, api = _admission_api(tmp_path, monkeypatch,
+                             MADSIM_TPU_FLEET_RATE_LIMIT="0.5",
+                             MADSIM_TPU_FLEET_RATE_BURST="2")
+    accepted, refused = [], []
+    for _ in range(6):  # burst: 2 tokens in the bucket, slow refill
+        status, _, body = api.handle(
+            "POST", "/jobs", json.dumps(SYN_SPEC).encode())
+        (accepted if status == 201 else refused).append(
+            (status, json.loads(body)))
+    assert [s for s, _ in accepted] == [201, 201]
+    assert [s for s, _ in refused] == [429] * 4
+    for _, doc in refused:
+        assert doc["reason"] == "rate_limited"
+        assert doc["tenant"] == "default"
+        assert doc["retry_after_s"] > 0
+        assert "retry after" in doc["error"]
+    # another tenant spends its OWN bucket — not starved by the burst
+    status, _, body = api.handle("POST", "/jobs", json.dumps(
+        {"spec": dict(SYN_SPEC), "tenant": "teamB"}).encode())
+    assert status == 201
+    accepted.append((status, json.loads(body)))
+
+    # zero accepted-job loss: every 201 is a durable job doc, and the
+    # farm drains them all once the burst stops
+    ids = [doc["id"] for _, doc in accepted]
+    assert sorted(ids) == sorted(j.id for j in st.list())
+    _drain(str(tmp_path / "farm"))
+    for jid in ids:
+        assert st.get(jid).terminal
+
+    _, _, mb = api.handle("GET", "/metrics")
+    text = mb.decode()
+    assert ('madsim_tpu_fleet_admission_total'
+            '{tenant="default",outcome="admitted"} 2') in text
+    assert ('madsim_tpu_fleet_admission_total'
+            '{tenant="default",outcome="rate_limited"} 4') in text
+    assert ('madsim_tpu_fleet_admission_total'
+            '{tenant="teamB",outcome="admitted"} 1') in text
+    assert "madsim_tpu_fleet_claim_conflicts_total 0" in text
+    assert "madsim_tpu_fleet_fenced_writes_total 0" in text
+
+
+def test_depth_cap_and_load_shed_degrade_reads_and_healthz(tmp_path,
+                                                           monkeypatch):
+    """Queue-depth admission + the shed ladder: the cap 429s new work,
+    the shed threshold flips the whole plane into degraded mode —
+    index-served reads, 503 health, a shed gauge — and everything
+    recovers the moment the backlog drains."""
+    st, api = _admission_api(tmp_path, monkeypatch,
+                             MADSIM_TPU_FLEET_MAX_QUEUE_DEPTH="3",
+                             MADSIM_TPU_FLEET_SHED_DEPTH="5")
+    for _ in range(3):
+        status, _, _ = api.handle(
+            "POST", "/jobs", json.dumps(SYN_SPEC).encode())
+        assert status == 201
+    status, _, body = api.handle(
+        "POST", "/jobs", json.dumps(SYN_SPEC).encode())
+    assert status == 429 and json.loads(body)["reason"] == "depth_limited"
+
+    # backlog grows past the shed threshold out-of-band (direct store
+    # submits model jobs accepted before the operator tightened knobs)
+    st.submit(dict(SYN_SPEC))
+    st.submit(dict(SYN_SPEC))
+    status, _, body = api.handle(
+        "POST", "/jobs", json.dumps(SYN_SPEC).encode())
+    doc = json.loads(body)
+    assert status == 429 and doc["reason"] == "shed"
+    assert doc["retry_after_s"] > 0
+
+    # /healthz: alive but degraded -> 503, shed named, workers/lag keys
+    status, _, body = api.handle("GET", "/healthz")
+    hz = json.loads(body)
+    assert status == 503 and hz["ok"] is False and hz["shed"] is True
+    assert "load-shedding" in hz["degraded"]
+    assert hz["store"]["corrupt_files"] == 0  # NOT a corruption 503
+    assert "workers" in hz and "queue_log_lag" in hz
+
+    # /jobs reads serve from the index while shedding: degraded rows,
+    # no momentum/event I/O, farm block says shed
+    status, _, body = api.handle("GET", "/jobs")
+    q = json.loads(body)
+    assert status == 200 and q["degraded"] is True
+    assert q["counts"]["queued"] == 5 and len(q["jobs"]) == 5
+    assert all(set(j) == {"id", "state", "worker"} for j in q["jobs"])
+    assert q["farm"]["shed"] is True
+
+    _, _, mb = api.handle("GET", "/metrics")
+    assert "madsim_tpu_fleet_shed 1" in mb.decode()
+    assert "madsim_tpu_fleet_sheds_total 1" in mb.decode()
+
+    # the backlog drains -> admission reopens, health goes green
+    _drain(str(tmp_path / "farm"))
+    status, _, body = api.handle("GET", "/healthz")
+    assert status == 200 and json.loads(body)["shed"] is False
+    status, _, body = api.handle(
+        "POST", "/jobs", json.dumps(SYN_SPEC).encode())
+    assert status == 201
+    status, _, body = api.handle("GET", "/jobs")
+    q = json.loads(body)
+    assert "degraded" not in q and "momentum" in q["jobs"][0]
+    assert q["farm"] == {"shed": False, "workers": q["farm"]["workers"],
+                         "queue_log_lag": 0}
+    _, _, mb = api.handle("GET", "/metrics")
+    assert "madsim_tpu_fleet_shed 0" in mb.decode()
+
+
+def test_retry_after_rides_the_wire_and_the_client_honors_it(tmp_path,
+                                                             monkeypatch):
+    """End-to-end over a real socket: the 429 carries an RFC
+    Retry-After header (integer rendering of the body's precise
+    retry_after_s), FleetClientError exposes it, and the retrying
+    client waits it out and lands the submit."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from madsim_tpu.fleet import client, httpd
+    from madsim_tpu.fleet.api import FleetAPI, make_handler
+
+    monkeypatch.setenv("MADSIM_TPU_FLEET_RATE_LIMIT", "5")
+    monkeypatch.setenv("MADSIM_TPU_FLEET_RATE_BURST", "1")
+    root = str(tmp_path / "farm")
+    srv, _host, port = httpd.bind(
+        "127.0.0.1:0", make_handler(FleetAPI(JobStore(root))))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        assert client.submit(addr, dict(SYN_SPEC))["id"]  # spends the token
+
+        # raw refusal: header + body agree on the price
+        req = urllib.request.Request(
+            f"http://{addr}/jobs", data=json.dumps(SYN_SPEC).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected 429")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 429
+            assert int(exc.headers["Retry-After"]) >= 1
+            assert json.loads(exc.read())["retry_after_s"] > 0
+
+        # the typed error carries the precise wait for --no-retry users
+        with pytest.raises(client.FleetClientError) as ei:
+            client.request(addr, "POST", "/jobs",
+                           {"spec": dict(SYN_SPEC)}, retries=0)
+        assert ei.value.status == 429 and ei.value.retry_after > 0
+
+        # the retrying client waits the named price, then lands it
+        t0 = time.monotonic()
+        out = client.submit(addr, dict(SYN_SPEC))
+        assert out["id"]
+        assert time.monotonic() - t0 >= 0.05  # waited, not hammered
+    finally:
+        srv.shutdown()
+        srv.server_close()
